@@ -1,0 +1,25 @@
+"""gymfx_tpu — TPU-native forex trading environment + RL training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of harveybc/gym-fx
+(reference: /root/reference).  The reference is a single-process,
+thread-synchronized Gymnasium environment driven by backtrader
+(reference app/env.py, app/bt_bridge.py); this framework replaces that
+design with pure functions over explicit state pytrees so thousands of
+episodes run under a single ``jit + vmap + lax.scan`` on TPU, sharded
+over a ``jax.sharding.Mesh`` at pod scale.
+
+Top-level layout:
+  config/    layered config system (defaults < file < CLI < overrides)
+  contracts  engine-neutral execution-cost / instrument contracts
+  data/      CSV -> columnar device arrays, NY-calendar precompute
+  core/      the functional environment: broker kernel, step/reset
+  plugins/   reward / preprocessor / strategy / metrics function families
+  parallel/  mesh + sharding utilities
+  train/     PPO / IMPALA actor-learner, policies, checkpointing
+  ops/       Pallas kernels and fused XLA ops
+  app/       CLI runner (gym-fx compatible surface)
+"""
+
+__version__ = "0.1.0"
+
+from gymfx_tpu.config import DEFAULT_VALUES, merge_config  # noqa: F401

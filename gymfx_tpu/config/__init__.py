@@ -1,0 +1,17 @@
+from gymfx_tpu.config.defaults import DEFAULT_VALUES
+from gymfx_tpu.config.merger import convert_type, merge_config, process_unknown_args
+from gymfx_tpu.config.handler import (
+    compose_config,
+    load_config,
+    save_config,
+)
+
+__all__ = [
+    "DEFAULT_VALUES",
+    "convert_type",
+    "merge_config",
+    "process_unknown_args",
+    "compose_config",
+    "load_config",
+    "save_config",
+]

@@ -1,0 +1,50 @@
+"""CLI argument surface — same flags as the reference CLI
+(reference app/cli.py:4-37) plus TPU-framework flags.  Unknown
+``--key value`` pairs pass through into the config with type coercion.
+"""
+import argparse
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="gymfx-tpu runtime (TPU-native env + trainer)."
+    )
+    parser.add_argument("--mode", choices=["training", "optimization", "inference"])
+    parser.add_argument(
+        "--driver_mode", choices=["random", "buy_hold", "flat", "replay", "policy"]
+    )
+    parser.add_argument("--steps", type=int)
+
+    parser.add_argument("--input_data_file", type=str)
+    parser.add_argument("--date_column", type=str)
+    parser.add_argument("--price_column", type=str)
+    parser.add_argument("--headers", action="store_true", default=None)
+    parser.add_argument("--max_rows", type=int)
+
+    parser.add_argument("--window_size", type=int)
+    parser.add_argument("--initial_cash", type=float)
+    parser.add_argument("--position_size", type=float)
+    parser.add_argument("--commission", type=float)
+    parser.add_argument("--slippage", type=float)
+    parser.add_argument("--seed", type=int)
+
+    parser.add_argument("--data_feed_plugin", type=str)
+    parser.add_argument("--broker_plugin", type=str)
+    parser.add_argument("--strategy_plugin", type=str)
+    parser.add_argument("--preprocessor_plugin", type=str)
+    parser.add_argument("--reward_plugin", type=str)
+    parser.add_argument("--metrics_plugin", type=str)
+
+    parser.add_argument("--replay_actions_file", type=str)
+    parser.add_argument("--results_file", type=str)
+    parser.add_argument("--load_config", type=str)
+    parser.add_argument("--save_config", type=str)
+    parser.add_argument("--quiet_mode", action="store_true", default=None)
+
+    # TPU-framework flags
+    parser.add_argument("--num_envs", type=int)
+    parser.add_argument("--policy", choices=["mlp", "lstm", "transformer"])
+    parser.add_argument("--checkpoint_dir", type=str)
+    parser.add_argument("--train_total_steps", type=int)
+
+    return parser.parse_known_args(argv)

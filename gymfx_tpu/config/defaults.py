@@ -1,0 +1,68 @@
+"""Repo-level config defaults.
+
+Same key surface as the reference defaults (reference app/config.py:1-47)
+so a gym-fx user can bring an existing JSON config unchanged, plus
+TPU-framework keys (batching, mesh, training) that the reference does not
+have because it is single-process Python.
+"""
+
+DEFAULT_VALUES = {
+    # execution
+    "mode": "inference",  # training|optimization|inference
+    "driver_mode": "buy_hold",  # random|buy_hold|flat|replay|policy
+    "steps": 500,
+
+    # plugin selection (registry names; mirrors reference entry-point names)
+    "data_feed_plugin": "default_data_feed",
+    "broker_plugin": "default_broker",
+    "strategy_plugin": "default_strategy",
+    "preprocessor_plugin": "default_preprocessor",
+    "reward_plugin": "pnl_reward",
+    "metrics_plugin": "default_metrics",
+
+    # data + symbol
+    "input_data_file": "examples/data/eurusd_sample.csv",  # repo-root relative
+    "date_column": "DATE_TIME",
+    "price_column": "CLOSE",
+    "instrument": "EUR_USD",
+    "timeframe": "M1",
+    "headers": True,
+    "max_rows": None,
+
+    # env and execution settings
+    "window_size": 32,
+    "initial_cash": 10000.0,
+    "position_size": 1.0,
+    "simulation_engine": "scan",  # the XLA scan engine (reference: backtrader|nautilus)
+    "execution_cost_profile": None,
+    "commission": 0.0,
+    "slippage": 0.0,
+    "leverage": 1.0,
+    "min_equity": None,  # default: 1% of initial_cash (reference app/env.py:122)
+    "action_space_mode": "discrete",  # discrete|continuous
+    "continuous_action_threshold": 0.33,
+    "seed": 0,
+
+    # optional replay actions
+    "replay_actions_file": None,
+
+    # config I/O
+    "remote_log": None,
+    "remote_load_config": None,
+    "remote_save_config": None,
+    "username": None,
+    "password": None,
+    "load_config": None,
+    "save_config": "./config_out.json",
+    "save_log": "./debug_out.json",
+    "results_file": "./results.json",
+    "quiet_mode": False,
+
+    # ---- TPU-framework keys (new capability; no reference counterpart) ----
+    "num_envs": 1,            # vmapped env batch size
+    "compute_dtype": "float32",   # float32 on TPU; float64 for oracle checks
+    "mesh_shape": None,       # e.g. {"data": 4, "model": 2}; None = single device
+    "train_total_steps": 1_000_000,
+    "checkpoint_dir": None,
+    "policy": "mlp",          # mlp|lstm|transformer
+}

@@ -1,0 +1,68 @@
+"""Layered config merge with fixed precedence.
+
+Precedence (low -> high), matching the reference merge semantics
+(reference app/config_merger.py:37-51):
+    plugin defaults < repo defaults < config file < explicit CLI args
+    (non-None) < unknown ``--key value`` args with type coercion.
+"""
+from typing import Any, Dict, Iterable, Mapping, Optional
+
+
+def process_unknown_args(unknown_args: Iterable[str]) -> Dict[str, Any]:
+    """Turn leftover ``--key value`` / ``--flag`` CLI tokens into a dict."""
+    args = list(unknown_args)
+    parsed: Dict[str, Any] = {}
+    i = 0
+    while i < len(args):
+        key = args[i]
+        if not key.startswith("--"):
+            i += 1
+            continue
+        if i + 1 < len(args) and not args[i + 1].startswith("--"):
+            parsed[key.lstrip("-")] = args[i + 1]
+            i += 2
+        else:
+            parsed[key.lstrip("-")] = True
+            i += 1
+    return parsed
+
+
+def convert_type(value: Any) -> Any:
+    """Coerce CLI string values: bool / None / int / float / str."""
+    if isinstance(value, bool):
+        return value
+    if not isinstance(value, str):
+        return value
+    lowered = value.strip().lower()
+    if lowered in {"true", "false"}:
+        return lowered == "true"
+    if lowered in {"none", "null"}:
+        return None
+    try:
+        return int(value)
+    except ValueError:
+        try:
+            return float(value)
+        except ValueError:
+            return value
+
+
+def merge_config(
+    defaults: Optional[Mapping[str, Any]],
+    plugin_params1: Optional[Mapping[str, Any]] = None,
+    plugin_params2: Optional[Mapping[str, Any]] = None,
+    file_config: Optional[Mapping[str, Any]] = None,
+    cli_args: Optional[Mapping[str, Any]] = None,
+    unknown_args: Optional[Mapping[str, Any]] = None,
+) -> Dict[str, Any]:
+    merged: Dict[str, Any] = {}
+    merged.update(plugin_params1 or {})
+    merged.update(plugin_params2 or {})
+    merged.update(defaults or {})
+    merged.update(file_config or {})
+    for key, value in (cli_args or {}).items():
+        if value is not None:
+            merged[key] = value
+    for key, value in (unknown_args or {}).items():
+        merged[key] = convert_type(value)
+    return merged

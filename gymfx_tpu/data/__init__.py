@@ -1,0 +1,1 @@
+from gymfx_tpu.data.feed import MarketDataset, load_market_dataset  # noqa: F401

@@ -1,0 +1,386 @@
+"""OANDA FX calendar — DST-aware America/New_York policy, precomputed.
+
+Zoneinfo/timezone logic cannot (and should not) run inside an XLA
+program, so the calendar is resolved host-side ONCE per dataset into
+per-bar feature columns that ship to the device as part of the market
+tensor.  The policy constants, window predicates and feature semantics
+match the reference pure-function library (reference
+app/oanda_calendar.py:30-240); the scalar predicates below are kept for
+API parity and for DST proof tests with paired summer/winter timestamps
+(reference tests/test_oanda_calendar.py:44-63).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+import pandas as pd
+from zoneinfo import ZoneInfo
+
+OANDA_FX_TIMEZONE = "America/New_York"
+CALENDAR_POLICY_ID = "oanda_us_fx_ny_v1"
+
+# Policy times (New York local). Mon=0 .. Sun=6.
+WEEKLY_OPEN_DOW = 6          # Sunday
+WEEKLY_OPEN_HM = (17, 5)
+WEEKLY_CLOSE_DOW = 4         # Friday
+WEEKLY_CLOSE_HM = (16, 59)
+DAILY_BREAK_START_HM = (16, 59)
+DAILY_BREAK_END_HM = (17, 5)
+NO_TRADE_WINDOW_START_HM = (16, 50)
+NO_TRADE_WINDOW_END_HM = (17, 10)
+FRIDAY_NO_NEW_POSITION_HM = (14, 0)
+FRIDAY_RISK_REDUCTION_HM = (15, 0)
+FRIDAY_FORCE_FLAT_HM = (15, 45)
+FRIDAY_LAST_EXIT_HM = (15, 55)
+BROKER_DAILY_BREAK_NEAR_MINUTES = 30
+
+_NY = ZoneInfo(OANDA_FX_TIMEZONE)
+
+CALENDAR_FEATURE_KEYS = (
+    "hours_to_fx_daily_break",
+    "bars_to_fx_daily_break",
+    "hours_to_friday_close",
+    "bars_to_friday_close",
+    "is_friday_risk_reduction_window",
+    "is_no_new_position_window",
+    "is_force_flat_window",
+    "is_broker_daily_break_near",
+    "broker_market_open",
+    "is_no_trade_window",
+)
+
+FORCE_CLOSE_FEATURE_KEYS = (
+    "bars_to_force_close",
+    "hours_to_force_close",
+    "is_force_close_zone",
+    "is_monday_entry_window",
+)
+
+
+def _hm_minutes(hm) -> int:
+    return hm[0] * 60 + hm[1]
+
+
+# ----------------------------------------------------------------------
+# Scalar API (host-side; parity with the reference predicate surface)
+# ----------------------------------------------------------------------
+def to_ny(ts: Any) -> Optional[_dt.datetime]:
+    """Coerce a timestamp-like value into an aware NY datetime.
+
+    Naive inputs are treated as UTC.  Returns None when unparseable.
+    """
+    if ts is None:
+        return None
+    if isinstance(ts, pd.Timestamp):
+        if ts is pd.NaT:
+            return None
+        # Plain datetime, not pd.Timestamp: wall-clock (not absolute)
+        # timedelta arithmetic is required for next-break/next-close math
+        # to match the reference's datetime-based policy across DST.
+        dt = ts.to_pydatetime()
+    elif isinstance(ts, _dt.datetime):
+        dt = ts
+    else:
+        try:
+            parsed = pd.to_datetime(str(ts).strip(), errors="coerce")
+        except (TypeError, ValueError):
+            return None
+        if parsed is None or parsed is pd.NaT:
+            return None
+        dt = parsed.to_pydatetime()
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return dt.astimezone(_NY)
+
+
+def _minute_of_day(dt: _dt.datetime) -> int:
+    return dt.hour * 60 + dt.minute
+
+
+def is_no_new_position_window(dt_ny: _dt.datetime) -> bool:
+    """True from Friday 14:00 NY through weekly close."""
+    if dt_ny.weekday() != WEEKLY_CLOSE_DOW:
+        return False
+    mod = _minute_of_day(dt_ny)
+    return _hm_minutes(FRIDAY_NO_NEW_POSITION_HM) <= mod < _hm_minutes(WEEKLY_CLOSE_HM)
+
+
+def is_friday_risk_reduction_window(dt_ny: _dt.datetime) -> bool:
+    """True from Friday 15:00 NY through weekly close."""
+    if dt_ny.weekday() != WEEKLY_CLOSE_DOW:
+        return False
+    mod = _minute_of_day(dt_ny)
+    return _hm_minutes(FRIDAY_RISK_REDUCTION_HM) <= mod < _hm_minutes(WEEKLY_CLOSE_HM)
+
+
+def is_force_flat_window(dt_ny: _dt.datetime) -> bool:
+    """True from Friday 15:45 NY through weekly close."""
+    if dt_ny.weekday() != WEEKLY_CLOSE_DOW:
+        return False
+    mod = _minute_of_day(dt_ny)
+    return _hm_minutes(FRIDAY_FORCE_FLAT_HM) <= mod < _hm_minutes(WEEKLY_CLOSE_HM)
+
+
+def is_broker_daily_break_near(
+    dt_ny: _dt.datetime, *, near_minutes: int = BROKER_DAILY_BREAK_NEAR_MINUTES
+) -> bool:
+    """True within ``near_minutes`` before, or inside, the 16:59-17:05 break."""
+    mod = _minute_of_day(dt_ny)
+    start = _hm_minutes(DAILY_BREAK_START_HM)
+    end = _hm_minutes(DAILY_BREAK_END_HM)
+    if start <= mod < end:
+        return True
+    return start - near_minutes < mod < start
+
+
+def is_no_trade_window(dt_ny: _dt.datetime) -> bool:
+    """Project no-trade window: 16:50-17:10 NY (covers the FX break)."""
+    mod = _minute_of_day(dt_ny)
+    return _hm_minutes(NO_TRADE_WINDOW_START_HM) <= mod < _hm_minutes(NO_TRADE_WINDOW_END_HM)
+
+
+def broker_market_open(dt_ny: _dt.datetime) -> bool:
+    """True between Sun 17:05 NY and Fri 16:59 NY, excluding the daily break."""
+    mod = _minute_of_day(dt_ny)
+    dow = dt_ny.weekday()
+    if dow == 5:  # Saturday
+        return False
+    if dow == WEEKLY_OPEN_DOW:
+        return mod >= _hm_minutes(WEEKLY_OPEN_HM)
+    if dow == WEEKLY_CLOSE_DOW and mod >= _hm_minutes(WEEKLY_CLOSE_HM):
+        return False
+    if _hm_minutes(DAILY_BREAK_START_HM) <= mod < _hm_minutes(DAILY_BREAK_END_HM):
+        return False
+    return True
+
+
+def compute_fx_calendar_features(
+    ts: Any, *, timeframe_hours: float = 4.0
+) -> Dict[str, float]:
+    """Single-timestamp calendar feature dict (neutral zeros on failure)."""
+    neutral = {k: 0.0 for k in CALENDAR_FEATURE_KEYS}
+    dt_ny = to_ny(ts)
+    if dt_ny is None:
+        return neutral
+    tf_h = max(float(timeframe_hours or 0.0), 1e-9)
+
+    hours_to_break = (_next_daily_break(dt_ny) - dt_ny).total_seconds() / 3600.0
+    hours_to_close = (_next_friday_close(dt_ny) - dt_ny).total_seconds() / 3600.0
+    return {
+        "hours_to_fx_daily_break": float(max(hours_to_break, 0.0)),
+        "bars_to_fx_daily_break": float(max(hours_to_break, 0.0) / tf_h),
+        "hours_to_friday_close": float(max(hours_to_close, 0.0)),
+        "bars_to_friday_close": float(max(hours_to_close, 0.0) / tf_h),
+        "is_friday_risk_reduction_window": float(is_friday_risk_reduction_window(dt_ny)),
+        "is_no_new_position_window": float(is_no_new_position_window(dt_ny)),
+        "is_force_flat_window": float(is_force_flat_window(dt_ny)),
+        "is_broker_daily_break_near": float(is_broker_daily_break_near(dt_ny)),
+        "broker_market_open": float(broker_market_open(dt_ny)),
+        "is_no_trade_window": float(is_no_trade_window(dt_ny)),
+    }
+
+
+def _next_daily_break(now_ny: _dt.datetime) -> _dt.datetime:
+    """Next 16:59 NY (wall clock) at or after ``now_ny``."""
+    today = now_ny.replace(
+        hour=DAILY_BREAK_START_HM[0],
+        minute=DAILY_BREAK_START_HM[1],
+        second=0,
+        microsecond=0,
+    )
+    if today <= now_ny:
+        today += _dt.timedelta(days=1)
+    return today
+
+
+def _next_friday_close(now_ny: _dt.datetime) -> _dt.datetime:
+    """Next Friday 16:59 NY (wall clock) at or after ``now_ny``."""
+    days_ahead = (WEEKLY_CLOSE_DOW - now_ny.weekday()) % 7
+    candidate = now_ny.replace(
+        hour=WEEKLY_CLOSE_HM[0],
+        minute=WEEKLY_CLOSE_HM[1],
+        second=0,
+        microsecond=0,
+    ) + _dt.timedelta(days=days_ahead)
+    if candidate < now_ny:
+        candidate += _dt.timedelta(days=7)
+    return candidate
+
+
+def resolve_broker_metadata(config: Mapping[str, Any]) -> Dict[str, Optional[str]]:
+    return {
+        "broker_profile": config.get("broker_profile"),
+        "market_type": config.get("market_type"),
+        "trade_rate_band_id": config.get("trade_rate_band_id"),
+        "calendar_policy_id": config.get("calendar_policy_id"),
+    }
+
+
+# ----------------------------------------------------------------------
+# Vectorized precompute (the TPU path): timestamps -> per-bar columns
+# ----------------------------------------------------------------------
+def _as_ny_index(timestamps: pd.Series | pd.DatetimeIndex) -> pd.DatetimeIndex:
+    idx = pd.DatetimeIndex(pd.to_datetime(pd.Series(np.asarray(timestamps)), errors="coerce"))
+    if idx.tz is None:
+        idx = idx.tz_localize("UTC")
+    return idx.tz_convert(OANDA_FX_TIMEZONE)
+
+
+def precompute_fx_calendar_features(
+    timestamps, *, timeframe_hours: float = 4.0
+) -> np.ndarray:
+    """Vectorized calendar features: (n, 10) float32 in CALENDAR_FEATURE_KEYS order.
+
+    Wall-clock "next break / next Friday close" arithmetic is done in NY
+    local time and differenced in UTC, so hours-to-X correctly spans DST
+    transitions exactly like the scalar reference semantics.
+    Unparseable timestamps produce an all-zero (neutral) row.
+    """
+    tf_h = max(float(timeframe_hours or 0.0), 1e-9)
+    ny = _as_ny_index(timestamps)
+    n = len(ny)
+    out = np.zeros((n, len(CALENDAR_FEATURE_KEYS)), dtype=np.float32)
+    valid = ~ny.isna()
+    if not valid.any():
+        return out
+    nyv = ny[valid]
+
+    dow = nyv.weekday.to_numpy()
+    mod = (nyv.hour * 60 + nyv.minute).to_numpy()
+
+    # Wall-clock differences in NY local time.  The reference subtracts
+    # two datetimes sharing one ZoneInfo, which Python defines as naive
+    # wall-clock subtraction (reference app/oanda_calendar.py:229-230) —
+    # so hours-to-X are NY wall-clock hours, not absolute elapsed hours,
+    # on DST transition days.  Reproduced here deliberately.
+    naive = nyv.tz_localize(None)
+    floor_day = naive.normalize()
+
+    # -- next daily break (16:59 NY wall clock, today or tomorrow) --------
+    break_minutes = _hm_minutes(DAILY_BREAK_START_HM)
+    today_break = floor_day + pd.Timedelta(minutes=break_minutes)
+    need_tomorrow = today_break <= naive
+    next_break_wall = today_break + pd.to_timedelta(np.where(need_tomorrow, 1, 0), unit="D")
+    hours_to_break = ((next_break_wall - naive).total_seconds() / 3600.0).to_numpy()
+
+    # -- next Friday 16:59 NY wall clock ----------------------------------
+    close_minutes = _hm_minutes(WEEKLY_CLOSE_HM)
+    days_ahead = (WEEKLY_CLOSE_DOW - dow) % 7
+    candidate_wall = floor_day + pd.to_timedelta(days_ahead, unit="D") + pd.Timedelta(
+        minutes=close_minutes
+    )
+    passed = candidate_wall < naive
+    candidate_wall = candidate_wall + pd.to_timedelta(np.where(passed, 7, 0), unit="D")
+    hours_to_close = ((candidate_wall - naive).total_seconds() / 3600.0).to_numpy()
+
+    # -- window predicates (pure minute-of-day/dow arithmetic) ------------
+    is_friday = dow == WEEKLY_CLOSE_DOW
+    before_close = mod < close_minutes
+    risk_red = is_friday & (mod >= _hm_minutes(FRIDAY_RISK_REDUCTION_HM)) & before_close
+    no_new = is_friday & (mod >= _hm_minutes(FRIDAY_NO_NEW_POSITION_HM)) & before_close
+    force_flat = is_friday & (mod >= _hm_minutes(FRIDAY_FORCE_FLAT_HM)) & before_close
+
+    brk_start = _hm_minutes(DAILY_BREAK_START_HM)
+    brk_end = _hm_minutes(DAILY_BREAK_END_HM)
+    in_break = (mod >= brk_start) & (mod < brk_end)
+    break_near = in_break | ((mod > brk_start - BROKER_DAILY_BREAK_NEAR_MINUTES) & (mod < brk_start))
+
+    no_trade = (mod >= _hm_minutes(NO_TRADE_WINDOW_START_HM)) & (
+        mod < _hm_minutes(NO_TRADE_WINDOW_END_HM)
+    )
+
+    open_mask = np.ones(len(nyv), dtype=bool)
+    open_mask &= dow != 5  # Saturday closed
+    sunday = dow == WEEKLY_OPEN_DOW
+    open_mask &= ~sunday | (mod >= _hm_minutes(WEEKLY_OPEN_HM))
+    open_mask &= ~(is_friday & (mod >= close_minutes))
+    open_mask &= ~(~sunday & in_break)  # Mon-Fri daily break (Sunday handled above)
+
+    block = np.stack(
+        [
+            np.maximum(hours_to_break, 0.0),
+            np.maximum(hours_to_break, 0.0) / tf_h,
+            np.maximum(hours_to_close, 0.0),
+            np.maximum(hours_to_close, 0.0) / tf_h,
+            risk_red.astype(np.float64),
+            no_new.astype(np.float64),
+            force_flat.astype(np.float64),
+            break_near.astype(np.float64),
+            open_mask.astype(np.float64),
+            no_trade.astype(np.float64),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    out[np.asarray(valid)] = block
+    return out
+
+
+def precompute_force_close_features(
+    timestamps,
+    *,
+    timeframe_hours: float,
+    force_close_dow: int = 4,
+    force_close_hour: int = 20,
+    force_close_window_hours: int = 4,
+    monday_entry_window_hours: int = 4,
+) -> np.ndarray:
+    """Stage-B force-close features: (n, 4) float32 in FORCE_CLOSE_FEATURE_KEYS order.
+
+    Matches the reference semantics (reference app/env.py:530-584): raw
+    (naive/UTC) weekday+hour arithmetic at hour granularity, no timezone
+    conversion; unparseable timestamps yield neutral zeros.
+    """
+    tf_hours = float(timeframe_hours) or 1.0
+    idx = pd.DatetimeIndex(pd.to_datetime(pd.Series(np.asarray(timestamps)), errors="coerce"))
+    if idx.tz is not None:
+        idx = idx.tz_localize(None)
+    n = len(idx)
+    out = np.zeros((n, 4), dtype=np.float32)
+    valid = ~idx.isna()
+    if not valid.any():
+        return out
+    v = idx[valid]
+    dow = v.weekday.to_numpy()
+    hour = v.hour.to_numpy()
+
+    days_ahead = (force_close_dow - dow) % 7
+    target_hours = days_ahead * 24 + (force_close_hour - hour)
+    target_hours = np.where(target_hours < 0, target_hours + 7 * 24, target_hours)
+    hours_to_fc = target_hours.astype(np.float64)
+    bars_to_fc = hours_to_fc / max(tf_hours, 1e-9)
+    in_zone = (dow == force_close_dow) & (hour >= force_close_hour) & (
+        hour < force_close_hour + force_close_window_hours
+    )
+    in_monday = (dow == 0) & (hour < monday_entry_window_hours)
+
+    out[np.asarray(valid)] = np.stack(
+        [
+            bars_to_fc,
+            hours_to_fc,
+            in_zone.astype(np.float64),
+            in_monday.astype(np.float64),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    return out
+
+
+def precompute_minute_of_week(timestamps) -> np.ndarray:
+    """Raw-timestamp minute-of-week (Mon 00:00 = 0), int32; -1 when invalid.
+
+    Used by the session/weekend filter, which in the reference compares
+    raw bar datetimes at minute-of-week granularity
+    (reference strategy_plugins/direct_atr_sltp.py:320-342).
+    """
+    idx = pd.DatetimeIndex(pd.to_datetime(pd.Series(np.asarray(timestamps)), errors="coerce"))
+    if idx.tz is not None:
+        idx = idx.tz_localize(None)
+    out = np.full(len(idx), -1, dtype=np.int32)
+    valid = ~idx.isna()
+    if valid.any():
+        v = idx[valid]
+        mow = v.weekday.to_numpy() * 24 * 60 + v.hour.to_numpy() * 60 + v.minute.to_numpy()
+        out[np.asarray(valid)] = mow.astype(np.int32)
+    return out

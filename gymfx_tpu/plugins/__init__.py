@@ -1,0 +1,16 @@
+"""Plugin families as registries of pure functions.
+
+The reference wires six plugin families through importlib.metadata entry
+points (reference setup.py:11-35, app/plugin_loader.py:12-48).  Python
+object indirection cannot live inside ``jit``, so here a "plugin" is a
+registered factory returning pure functions + a params pytree; the
+family/registry architecture, default-param self-description and config
+precedence are preserved.
+"""
+from gymfx_tpu.plugins.registry import (  # noqa: F401
+    available,
+    get_plugin,
+    get_plugin_params,
+    load_plugin,
+    register,
+)

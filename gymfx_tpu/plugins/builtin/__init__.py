@@ -1,0 +1,6 @@
+"""Built-in plugin registrations.
+
+Importing this package registers the built-in members of all six plugin
+families (reference entry-point groups, setup.py:11-35).  Modules are
+added here as the corresponding family lands.
+"""

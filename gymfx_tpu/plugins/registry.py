@@ -1,0 +1,64 @@
+"""In-process plugin registry.
+
+Replaces the reference's entry-point loading (reference
+app/plugin_loader.py:12-48) with an explicit registry: same lookup
+surface — ``load_plugin(group, name) -> (factory, required_param_keys)``
+— without the packaging machinery, so registration works inside one
+repo and third parties can still ``register()`` their own.
+"""
+from typing import Any, Callable, Dict, List, Tuple
+
+# group -> name -> (factory, plugin_params)
+_REGISTRY: Dict[str, Dict[str, Tuple[Callable[..., Any], Dict[str, Any]]]] = {}
+
+GROUPS = (
+    "data_feed.plugins",
+    "broker.plugins",
+    "strategy.plugins",
+    "preprocessor.plugins",
+    "reward.plugins",
+    "metrics.plugins",
+)
+
+
+def register(group: str, name: str, plugin_params: Dict[str, Any] | None = None):
+    """Decorator: register ``factory`` under ``group``/``name``."""
+
+    def deco(factory: Callable[..., Any]):
+        _REGISTRY.setdefault(group, {})[name] = (factory, dict(plugin_params or {}))
+        factory.plugin_params = dict(plugin_params or {})  # type: ignore[attr-defined]
+        return factory
+
+    return deco
+
+
+def _ensure_builtins_loaded() -> None:
+    # Import for side effect: built-in plugins self-register on import.
+    import gymfx_tpu.plugins.builtin  # noqa: F401
+
+
+def get_plugin(group: str, name: str) -> Callable[..., Any]:
+    _ensure_builtins_loaded()
+    try:
+        return _REGISTRY[group][name][0]
+    except KeyError:
+        raise ImportError(f"Plugin {name} not found in group {group}.") from None
+
+
+def get_plugin_params(group: str, name: str) -> Dict[str, Any]:
+    _ensure_builtins_loaded()
+    try:
+        return dict(_REGISTRY[group][name][1])
+    except KeyError:
+        raise ImportError(f"Plugin {name} not found in group {group}.") from None
+
+
+def load_plugin(group: str, name: str) -> Tuple[Callable[..., Any], List[str]]:
+    """Reference-compatible: return (factory, required param keys)."""
+    factory = get_plugin(group, name)
+    return factory, list(get_plugin_params(group, name).keys())
+
+
+def available(group: str) -> List[str]:
+    _ensure_builtins_loaded()
+    return sorted(_REGISTRY.get(group, {}).keys())

@@ -1,0 +1,131 @@
+"""NY calendar policy: DST proofs, window predicates, and equivalence of
+the vectorized precompute against the scalar reference-parity functions
+(reference tests/test_oanda_calendar.py coverage model)."""
+import datetime as _dt
+
+import numpy as np
+import pandas as pd
+import pytest
+from zoneinfo import ZoneInfo
+
+from gymfx_tpu.data import calendar as cal
+
+NY = ZoneInfo(cal.OANDA_FX_TIMEZONE)
+
+
+def _ny(ts: str) -> _dt.datetime:
+    return _dt.datetime.fromisoformat(ts).replace(tzinfo=NY)
+
+
+def test_policy_id_is_stable():
+    assert cal.CALENDAR_POLICY_ID == "oanda_us_fx_ny_v1"
+
+
+def test_friday_close_uses_zoneinfo_not_fixed_utc_offset():
+    # Friday 16:59 NY == 20:59 UTC in EDT (summer), 21:59 UTC in EST (winter).
+    summer = _dt.datetime(2024, 6, 7, 20, 59, tzinfo=_dt.timezone.utc)
+    winter = _dt.datetime(2024, 12, 6, 21, 59, tzinfo=_dt.timezone.utc)
+    for ts in (summer, winter):
+        feats = cal.compute_fx_calendar_features(ts, timeframe_hours=4)
+        assert feats["hours_to_friday_close"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_summer_utc_one_hour_before_friday_close():
+    feats = cal.compute_fx_calendar_features(
+        _dt.datetime(2024, 6, 7, 19, 59, tzinfo=_dt.timezone.utc), timeframe_hours=4
+    )
+    assert feats["hours_to_friday_close"] == pytest.approx(1.0, abs=1e-6)
+    assert feats["is_force_flat_window"] == 1.0
+
+
+def test_friday_windows():
+    assert cal.is_no_new_position_window(_ny("2024-06-07 13:59")) is False
+    assert cal.is_no_new_position_window(_ny("2024-06-07 14:00")) is True
+    assert cal.is_no_new_position_window(_ny("2024-06-07 16:59")) is False
+    assert cal.is_friday_risk_reduction_window(_ny("2024-06-07 15:00")) is True
+    assert cal.is_friday_risk_reduction_window(_ny("2024-06-08 15:30")) is False
+    assert cal.is_force_flat_window(_ny("2024-06-07 15:44")) is False
+    assert cal.is_force_flat_window(_ny("2024-06-07 15:45")) is True
+
+
+def test_daily_break_and_no_trade_windows():
+    assert cal.is_broker_daily_break_near(_ny("2024-06-05 16:29")) is False
+    assert cal.is_broker_daily_break_near(_ny("2024-06-05 16:30")) is True
+    assert cal.is_broker_daily_break_near(_ny("2024-06-05 17:00")) is True
+    assert cal.is_broker_daily_break_near(_ny("2024-06-05 17:05")) is False
+    assert cal.is_no_trade_window(_ny("2024-06-05 16:50")) is True
+    assert cal.is_no_trade_window(_ny("2024-06-05 17:10")) is False
+
+
+def test_broker_market_open():
+    assert cal.broker_market_open(_ny("2024-06-08 12:00")) is False  # Saturday
+    assert cal.broker_market_open(_ny("2024-06-09 17:04")) is False  # Sun pre-open
+    assert cal.broker_market_open(_ny("2024-06-09 17:05")) is True
+    assert cal.broker_market_open(_ny("2024-06-05 16:59")) is False  # daily break
+    assert cal.broker_market_open(_ny("2024-06-05 17:05")) is True
+    assert cal.broker_market_open(_ny("2024-06-07 16:59")) is False  # weekly close
+
+
+def test_unparseable_timestamp_neutral():
+    feats = cal.compute_fx_calendar_features("not a timestamp", timeframe_hours=4)
+    assert all(v == 0.0 for v in feats.values())
+
+
+# ----- vectorized precompute ==============================================
+def test_vectorized_matches_scalar_over_dst_and_week_boundaries():
+    # A grid crossing: winter, spring-forward (2024-03-10), summer,
+    # fall-back (2024-11-03), Fridays, Saturdays, Sunday opens.
+    stamps = pd.to_datetime(
+        [
+            "2024-01-03 12:00:00",
+            "2024-03-09 21:58:00",
+            "2024-03-10 06:59:00",   # spring-forward day
+            "2024-03-11 00:00:00",
+            "2024-06-07 19:59:00",   # Fri 15:59 NY EDT
+            "2024-06-07 20:59:00",   # Fri 16:59 NY EDT (weekly close)
+            "2024-06-08 12:00:00",   # Saturday
+            "2024-06-09 21:05:00",   # Sun 17:05 NY EDT (weekly open)
+            "2024-11-02 20:00:00",
+            "2024-11-03 05:30:00",   # fall-back day
+            "2024-12-06 21:59:00",   # Fri 16:59 NY EST
+            "2024-12-04 21:58:00",   # Wed 16:58 NY EST
+        ]
+    )
+    vec = cal.precompute_fx_calendar_features(stamps, timeframe_hours=4.0)
+    for i, ts in enumerate(stamps):
+        scalar = cal.compute_fx_calendar_features(ts, timeframe_hours=4.0)
+        for j, key in enumerate(cal.CALENDAR_FEATURE_KEYS):
+            assert vec[i, j] == pytest.approx(scalar[key], abs=2e-4), (ts, key)
+
+
+def test_vectorized_neutral_row_for_nat():
+    stamps = pd.to_datetime(pd.Series(["2024-06-05 12:00:00", None]), errors="coerce")
+    vec = cal.precompute_fx_calendar_features(stamps, timeframe_hours=1.0)
+    assert np.all(vec[1] == 0.0)
+    assert vec[0, 8] == 1.0  # broker_market_open mid-week
+
+
+def test_force_close_features_raw_utc_hour_arithmetic():
+    # Reference stage-B semantics (app/env.py:558-571): raw weekday/hour, no tz.
+    stamps = pd.to_datetime(
+        ["2024-06-07 20:00:00", "2024-06-07 19:00:00", "2024-06-03 02:00:00"]
+    )
+    out = cal.precompute_force_close_features(
+        stamps, timeframe_hours=1.0, force_close_dow=4, force_close_hour=20
+    )
+    # Friday 20:00: 0 hours to force close, inside the zone.
+    assert out[0, 1] == 0.0 and out[0, 2] == 1.0
+    # Friday 19:00: one hour to go, not yet in zone.
+    assert out[1, 1] == 1.0 and out[1, 2] == 0.0
+    # Monday 02:00: inside the 4h Monday entry window.
+    assert out[2, 3] == 1.0
+    # bars == hours at 1h timeframe
+    assert np.allclose(out[:, 0], out[:, 1])
+
+
+def test_minute_of_week():
+    stamps = pd.to_datetime(["2024-06-03 00:01:00", "2024-06-07 20:30:00", None])
+    mow = cal.precompute_minute_of_week(pd.Series(stamps))
+    assert mow[0] == 1  # Monday 00:01
+    assert mow[1] == 4 * 24 * 60 + 20 * 60 + 30
+    assert mow[2] == -1

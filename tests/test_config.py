@@ -1,0 +1,78 @@
+"""Config system: layered merge precedence, type coercion, JSON I/O.
+
+Mirrors the reference merge semantics (reference app/config_merger.py:37-51,
+app/config_handler.py:6-24).
+"""
+import json
+
+from gymfx_tpu.config import (
+    DEFAULT_VALUES,
+    compose_config,
+    convert_type,
+    load_config,
+    merge_config,
+    process_unknown_args,
+    save_config,
+)
+
+
+def test_merge_precedence_low_to_high():
+    merged = merge_config(
+        {"a": "defaults", "b": "defaults", "c": "defaults", "d": "defaults"},
+        {"a": "plugin1", "z": "plugin1"},
+        {"a": "plugin2"},
+        {"b": "file", "a": "file"},
+        {"c": "cli", "ignored": None},
+        {"d": "unknown"},
+    )
+    assert merged["a"] == "file"        # file beats defaults beats plugins
+    assert merged["b"] == "file"
+    assert merged["c"] == "cli"         # explicit CLI beats file
+    assert merged["d"] == "unknown"     # unknown args beat everything
+    assert merged["z"] == "plugin1"     # plugin-only keys survive
+    assert "ignored" not in merged      # None CLI values are skipped
+
+
+def test_cli_none_does_not_override():
+    merged = merge_config({"steps": 500}, None, None, {"steps": 100}, {"steps": None}, {})
+    assert merged["steps"] == 100
+
+
+def test_process_unknown_args_pairs_and_flags():
+    parsed = process_unknown_args(
+        ["--alpha", "0.5", "--flag", "--name", "abc", "positional", "--tail"]
+    )
+    assert parsed == {"alpha": "0.5", "flag": True, "name": "abc", "tail": True}
+
+
+def test_convert_type_coercion():
+    assert convert_type("true") is True
+    assert convert_type("False") is False
+    assert convert_type("none") is None
+    assert convert_type("42") == 42
+    assert convert_type("0.5") == 0.5
+    assert convert_type("hello") == "hello"
+    assert convert_type(True) is True
+    assert convert_type(3) == 3
+
+
+def test_unknown_args_are_type_coerced_in_merge():
+    merged = merge_config({}, None, None, None, None, {"lr": "0.001", "on": "true"})
+    assert merged["lr"] == 0.001
+    assert merged["on"] is True
+
+
+def test_compose_config_drops_defaults_and_roundtrips(tmp_path):
+    config = dict(DEFAULT_VALUES)
+    config["steps"] = 123  # non-default
+    config["custom_key"] = "xyz"
+    composed = compose_config(config)
+    assert composed["steps"] == 123
+    assert composed["custom_key"] == "xyz"
+    assert "mode" not in composed  # unchanged default dropped
+
+    path = tmp_path / "cfg.json"
+    save_config(config, str(path))
+    loaded = load_config(str(path))
+    assert loaded == json.loads(path.read_text())
+    assert loaded["steps"] == 123

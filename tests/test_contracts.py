@@ -1,0 +1,88 @@
+"""ExecutionCostProfile contract validation (reference
+simulation_engines/contracts.py:50-106 semantics)."""
+import json
+
+import pytest
+
+from gymfx_tpu.contracts import (
+    ExecutionCostProfile,
+    InstrumentSpec,
+    load_execution_cost_profile,
+)
+
+
+def _valid_raw(**overrides):
+    raw = {
+        "schema_version": "execution_cost_profile.v1",
+        "profile_id": "test.profile",
+        "commission_rate_per_side": 0.00002,
+        "full_spread_rate": 0.0001,
+        "slippage_bps_per_side": 0.5,
+        "latency_ms": 5,
+        "financing_enabled": False,
+        "intrabar_collision_policy": "worst_case",
+        "limit_fill_policy": "conservative",
+        "margin_model": "leveraged",
+        "enforce_margin_preflight": True,
+        "random_seed": 7,
+    }
+    raw.update(overrides)
+    return raw
+
+
+def test_valid_profile_parses_and_derives_rates():
+    p = ExecutionCostProfile.from_dict(_valid_raw())
+    assert p.slippage_rate_per_side == pytest.approx(0.5 / 10_000)
+    assert p.quote_adverse_rate_per_side == pytest.approx(
+        0.0001 / 2 + 0.5 / 10_000
+    )
+
+
+def test_missing_fields_rejected():
+    raw = _valid_raw()
+    del raw["latency_ms"]
+    with pytest.raises(ValueError, match="missing fields"):
+        ExecutionCostProfile.from_dict(raw)
+
+
+def test_bad_schema_version_rejected():
+    with pytest.raises(ValueError, match="schema_version"):
+        ExecutionCostProfile.from_dict(_valid_raw(schema_version="v2"))
+
+
+@pytest.mark.parametrize(
+    "field,value,match",
+    [
+        ("commission_rate_per_side", -0.1, "cannot be negative"),
+        ("full_spread_rate", 1.5, "below 1"),
+        ("latency_ms", -1, "cannot be negative"),
+        ("intrabar_collision_policy", "magic", "intrabar_collision_policy"),
+        ("limit_fill_policy", "magic", "limit_fill_policy"),
+        ("margin_model", "magic", "margin_model"),
+        ("slippage_bps_per_side", float("nan"), "finite"),
+    ],
+)
+def test_invalid_values_rejected(field, value, match):
+    with pytest.raises(ValueError, match=match):
+        ExecutionCostProfile.from_dict(_valid_raw(**{field: value}))
+
+
+def test_load_from_file(tmp_path):
+    path = tmp_path / "profile.json"
+    path.write_text(json.dumps(_valid_raw()))
+    p = load_execution_cost_profile(path)
+    assert p.profile_id == "test.profile"
+
+
+def test_instrument_spec_id():
+    spec = InstrumentSpec(
+        symbol="EUR/USD",
+        venue="SIM",
+        base_currency="EUR",
+        quote_currency="USD",
+        price_precision=5,
+        size_precision=0,
+        margin_init=0.02,
+        margin_maint=0.02,
+    )
+    assert spec.instrument_id == "EUR/USD.SIM"

@@ -1,0 +1,160 @@
+"""Data pipeline: CSV load semantics, padded windows, scaler moments.
+
+Load semantics mirror the reference feed (reference
+data_feed_plugins/default_data_feed.py:36-56); moment precompute is
+validated against direct numpy recomputation of the reference scaling
+(reference preprocessor_plugins/feature_window_preprocessor.py:174-191).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from gymfx_tpu.data.feed import (
+    MarketDataset,
+    _build_feature_tensors,
+    load_dataframe,
+    load_market_dataset,
+)
+
+SAMPLE = str(
+    __import__("pathlib").Path(__file__).resolve().parent.parent
+    / "examples" / "data" / "eurusd_sample.csv"
+)
+
+
+def _write_csv(tmp_path, name="data.csv", rows=60, with_ohlc=True):
+    rng = np.random.default_rng(0)
+    ts = pd.date_range("2024-01-01", periods=rows, freq="1min")
+    close = 1.1 + np.cumsum(rng.normal(0, 1e-4, rows))
+    df = pd.DataFrame({"DATE_TIME": ts, "CLOSE": close})
+    if with_ohlc:
+        df["OPEN"] = close + 1e-5
+        df["HIGH"] = close + 2e-5
+        df["LOW"] = close - 2e-5
+        df["VOLUME"] = rng.integers(1, 100, rows)
+    path = tmp_path / name
+    df.to_csv(path, index=False)
+    return path, df
+
+
+def test_load_backfills_ohlc_and_volume(tmp_path):
+    path, _ = _write_csv(tmp_path, with_ohlc=False)
+    df = load_dataframe({"input_data_file": str(path)})
+    for col in ("OPEN", "HIGH", "LOW", "CLOSE", "VOLUME"):
+        assert col in df.columns
+    assert np.allclose(df["OPEN"], df["CLOSE"])
+    assert (df["VOLUME"] == 0).all()
+    assert isinstance(df.index, pd.DatetimeIndex)
+
+
+def test_load_sample_csv():
+    df = load_dataframe({"input_data_file": SAMPLE})
+    assert len(df) >= 400
+    assert {"OPEN", "HIGH", "LOW", "CLOSE", "VOLUME"}.issubset(df.columns)
+
+
+def test_max_rows_and_missing_price_column(tmp_path):
+    path, _ = _write_csv(tmp_path)
+    df = load_dataframe({"input_data_file": str(path), "max_rows": 10})
+    assert len(df) == 10
+    with pytest.raises(ValueError, match="price_column"):
+        load_dataframe({"input_data_file": str(path), "price_column": "MISSING"})
+
+
+def test_market_data_shapes_and_padding(tmp_path):
+    path, raw = _write_csv(tmp_path)
+    ds = load_market_dataset({"input_data_file": str(path), "timeframe": "M1"})
+    w = 8
+    md = ds.build_market_data(window_size=w)
+    n = len(raw)
+    assert md.n_bars == n
+    assert md.padded_close.shape == (n + w,)
+    # Front pad is the first close value (reference front-pad semantics).
+    first = raw["CLOSE"].iloc[0]
+    assert np.allclose(np.asarray(md.padded_close[:w]), first, atol=1e-6)
+    assert np.allclose(np.asarray(md.padded_close[w:]), raw["CLOSE"].to_numpy(), atol=1e-6)
+    assert md.calendar.shape == (n, 10)
+    assert md.force_close.shape == (n, 4)
+    assert md.minute_of_week.shape == (n,)
+    assert md.padded_features.shape == (n + w, 0)
+    # Neutral event context when columns are absent.
+    assert np.all(np.asarray(md.ev_no_trade) == 0.0)
+    assert np.all(np.asarray(md.ev_spread_mult) == 1.0)
+    assert np.all(np.asarray(md.ev_slip_mult) == 1.0)
+
+
+def test_too_short_data_rejected(tmp_path):
+    path, _ = _write_csv(tmp_path, rows=5)
+    ds = load_market_dataset({"input_data_file": str(path)})
+    with pytest.raises(ValueError, match="too short"):
+        ds.build_market_data(window_size=32)
+
+
+def _reference_moments(values, t, mode, scale_window):
+    """Direct (slow) recomputation of the reference scaler fit."""
+    if mode == "rolling_zscore":
+        hist = values[max(0, t - scale_window):t]
+    else:
+        hist = values[:t]
+    if hist.shape[0] < 2:
+        return None  # neutral
+    mean = hist.mean(axis=0)
+    std = hist.std(axis=0)
+    std = np.where(std < 1e-8, 1.0, std)
+    return mean, std
+
+
+@pytest.mark.parametrize("mode", ["rolling_zscore", "expanding_zscore"])
+def test_feature_moments_match_direct_recompute(mode):
+    rng = np.random.default_rng(1)
+    n, f, w, sw = 300, 3, 16, 64
+    df = pd.DataFrame(
+        rng.normal(size=(n, f)) * [1.0, 100.0, 1e-3] + [0.0, 50.0, 1.0],
+        columns=["a", "b", "c"],
+    )
+    padded, mean, std, neutral = _build_feature_tensors(
+        df,
+        feature_columns=("a", "b", "c"),
+        window_size=w,
+        scaling=mode,
+        scaling_window=sw,
+    )
+    assert padded.shape == (n + w, f)
+    values = df.to_numpy(np.float64)
+    for t in [0, 1, 2, 3, 10, sw - 1, sw, sw + 5, n]:
+        ref = _reference_moments(values, t, mode, sw)
+        if ref is None:
+            assert neutral[t]
+        else:
+            assert not neutral[t]
+            np.testing.assert_allclose(mean[t], ref[0], rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(std[t], ref[1], rtol=1e-4, atol=1e-6)
+
+
+def test_constant_column_gets_unit_std():
+    df = pd.DataFrame({"x": np.ones(50)})
+    _, mean, std, neutral = _build_feature_tensors(
+        df, feature_columns=("x",), window_size=4, scaling="rolling_zscore",
+        scaling_window=16,
+    )
+    assert np.all(std[~neutral] == 1.0)
+    assert np.allclose(mean[10], 1.0)
+
+
+def test_bad_scaling_mode_rejected():
+    df = pd.DataFrame({"x": np.arange(50.0)})
+    with pytest.raises(ValueError, match="feature_scaling"):
+        _build_feature_tensors(
+            df, feature_columns=("x",), window_size=4, scaling="magic",
+            scaling_window=16,
+        )
+
+
+def test_timeframe_inference():
+    cfgs = {"M1": 1 / 60, "15m": 0.25, "H4": 4.0, "h1": 1.0, "D1": 24.0, "xx_30m": 0.5, "": 0.0}
+    for label, hours in cfgs.items():
+        ds = MarketDataset(
+            pd.DataFrame({"CLOSE": np.ones(40)}),
+            {"timeframe": label, "price_column": "CLOSE"},
+        )
+        assert ds.timeframe_hours == pytest.approx(hours), label

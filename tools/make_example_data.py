@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Generate the synthetic example datasets under examples/data/.
+
+Deterministic (seeded) EUR/USD-like minute bars with the same schema as
+the reference examples (DATE_TIME,OPEN,HIGH,LOW,CLOSE,VOLUME — reference
+examples/data/eurusd_sample.csv header) but freshly generated values:
+
+  eurusd_sample.csv   500 bars, mild mean-reverting random walk
+  eurusd_uptrend.csv  500 bars, strict monotonic uptrend (smoke tests:
+                      buy&hold must yield a positive return on it)
+"""
+import pathlib
+
+import numpy as np
+import pandas as pd
+
+OUT = pathlib.Path(__file__).resolve().parent.parent / "examples" / "data"
+
+
+def make_sample(n: int = 500, seed: int = 20240101) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    ts = pd.date_range("2024-01-01 00:00:00", periods=n, freq="1min")
+    steps = rng.normal(0.0, 8e-5, n)
+    mid = 1.10 + np.cumsum(steps) - 0.02 * np.cumsum(steps).cumsum() / np.arange(1, n + 1)
+    close = np.round(mid, 5)
+    spread = rng.uniform(1e-5, 9e-5, n)
+    open_ = np.round(close + rng.normal(0, 5e-5, n), 5)
+    high = np.round(np.maximum(open_, close) + spread, 5)
+    low = np.round(np.minimum(open_, close) - spread, 5)
+    volume = rng.integers(50, 2000, n)
+    return pd.DataFrame(
+        {
+            "DATE_TIME": ts.strftime("%Y-%m-%d %H:%M:%S"),
+            "OPEN": open_,
+            "HIGH": high,
+            "LOW": low,
+            "CLOSE": close,
+            "VOLUME": volume,
+        }
+    )
+
+
+def make_uptrend(n: int = 500) -> pd.DataFrame:
+    ts = pd.date_range("2024-01-01 00:00:00", periods=n, freq="1min")
+    close = 1.10 * (1.0 + 2e-4) ** np.arange(n)
+    return pd.DataFrame(
+        {
+            "DATE_TIME": ts.strftime("%Y-%m-%d %H:%M:%S"),
+            "OPEN": close,
+            "HIGH": close + 1e-5,
+            "LOW": close - 1e-5,
+            "CLOSE": close,
+            "VOLUME": np.zeros(n, dtype=int),
+        }
+    )
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    make_sample().to_csv(OUT / "eurusd_sample.csv", index=False)
+    make_uptrend().to_csv(OUT / "eurusd_uptrend.csv", index=False)
+    print(f"wrote {OUT}/eurusd_sample.csv and eurusd_uptrend.csv")
+
+
+if __name__ == "__main__":
+    main()
