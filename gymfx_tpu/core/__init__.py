@@ -1,0 +1,8 @@
+from gymfx_tpu.core.types import (  # noqa: F401
+    EnvConfig,
+    EnvParams,
+    EnvState,
+    make_env_config,
+    make_env_params,
+)
+from gymfx_tpu.core.env import reset, step  # noqa: F401

@@ -1,0 +1,218 @@
+"""Branch-free broker ledger kernel.
+
+Replaces backtrader's BackBroker + order matching (the engine side of
+reference app/bt_bridge.py:136-248, broker config
+broker_plugins/default_broker.py:35-53) with pure functions over the
+``EnvState`` ledger fields, composable under ``jit``/``vmap``/``scan``.
+
+Execution model (matching backtrader's default, no cheat-on-open):
+  * market orders created at bar t (the strategy acts on bar t's close)
+    execute at bar t+1's OPEN;
+  * percent slippage is applied adversely by fill direction
+    (buy: open*(1+slip); sell: open*(1-slip));
+  * commission = commission_rate * fill_price * |units| per executed
+    order; a long<->short flip is close+open = two orders, equivalent
+    to commission on |delta| at one fill price;
+  * equity = cash + position * close, marked at every bar close.
+
+Bracket (SL/TP) semantics: armed when the parent entry fills; evaluated
+against each bar's H/L while the position is open; collision policies
+``worst_case`` (SL wins when both touched — reference
+simulation_engines/contracts.py:100, bakeoff fixture semantics
+bakeoff.py:116-163), ``ohlc`` (O->H->L->C path order) and ``adaptive``
+(treated as worst_case).  Deliberate divergence from the reference
+backtrader path: closing a bracketed position cancels its children
+(backtrader leaves orphaned child orders alive — a latent footgun the
+scan kernel does not reproduce).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from gymfx_tpu.core.types import EnvConfig, EnvParams, EnvState
+
+
+def apply_fill(
+    state: EnvState, fill_price, target_units, params: EnvParams
+) -> EnvState:
+    """Move the position to ``target_units`` at ``fill_price`` (pre-slippage).
+
+    No-op when ``target_units == pos``.  Handles open/add/reduce/close/
+    flip with avg-entry-price tracking, commission accrual and
+    closed-trade statistics.
+    """
+    d = state.pos.dtype
+    pos = state.pos
+    target = jnp.asarray(target_units, dtype=d)
+    delta = target - pos
+    direction = jnp.sign(delta)
+    fill = fill_price * (1.0 + params.slippage * direction)
+
+    abs_pos = jnp.abs(pos)
+    abs_target = jnp.abs(target)
+    same_sign = pos * target > 0
+    # units closed out of the existing position by this fill
+    closed = jnp.where(
+        same_sign,
+        jnp.maximum(abs_pos - abs_target, 0.0),
+        abs_pos,
+    )
+    closed = jnp.where(delta == 0, 0.0, closed)
+    opened = jnp.abs(delta) - closed
+
+    realized = closed * (fill - state.entry_price) * jnp.sign(pos)
+    commission = params.commission * fill * jnp.abs(delta)
+    comm_close = params.commission * fill * closed
+    comm_open = commission - comm_close
+
+    cash_delta = state.cash_delta - delta * fill - commission
+
+    # average entry price of the resulting position
+    new_abs = jnp.abs(target)
+    adding = same_sign & (abs_target > abs_pos)
+    flipping = (~same_sign) & (target != 0) & (pos != 0)
+    opening = (pos == 0) & (target != 0)
+    entry = jnp.where(
+        adding,
+        (state.entry_price * abs_pos + fill * (new_abs - abs_pos)) / jnp.maximum(new_abs, 1e-30),
+        state.entry_price,
+    )
+    entry = jnp.where(flipping | opening, fill, entry)
+    entry = jnp.where(target == 0, 0.0, entry)
+
+    # closed-trade bookkeeping: a trade closes when the old position is
+    # fully exited (to flat or by flip) — reference counts on
+    # trade.isclosed (app/bt_bridge.py:132-134)
+    trade_closed = (pos != 0) & ((target == 0) | flipping)
+    trade_net = realized - (state.open_trade_commission + comm_close)
+    trade_count = state.trade_count + trade_closed.astype(jnp.int32)
+    trade_pnl_sum = state.trade_pnl_sum + jnp.where(trade_closed, trade_net, 0.0)
+    trade_pnl_sumsq = state.trade_pnl_sumsq + jnp.where(trade_closed, trade_net**2, 0.0)
+    trades_won = state.trades_won + (trade_closed & (trade_net > 0)).astype(jnp.int32)
+    trades_lost = state.trades_lost + (trade_closed & (trade_net < 0)).astype(jnp.int32)
+    open_trade_commission = jnp.where(
+        trade_closed, comm_open, state.open_trade_commission + comm_open
+    )
+    open_trade_commission = jnp.where(target == 0, 0.0, open_trade_commission)
+
+    return state._replace(
+        pos=target,
+        entry_price=entry,
+        cash_delta=cash_delta,
+        commission_paid=state.commission_paid + commission,
+        last_trade_cost=state.last_trade_cost + commission,
+        trade_count=trade_count,
+        trade_pnl_sum=trade_pnl_sum,
+        trade_pnl_sumsq=trade_pnl_sumsq,
+        trades_won=trades_won,
+        trades_lost=trades_lost,
+        open_trade_commission=open_trade_commission,
+    )
+
+
+def fill_pending(state: EnvState, open_price, params: EnvParams) -> EnvState:
+    """Execute the pending market order at the new bar's open."""
+    target = jnp.where(state.pending_active, state.pending_target, state.pos)
+    new_state = apply_fill(state, open_price, target, params)
+    entered = state.pending_active & (new_state.pos != 0)
+    # Arm the pending brackets when the entry executed; clear brackets if
+    # the position was closed by this fill.
+    bracket_sl = jnp.where(entered, state.pending_sl, state.bracket_sl)
+    bracket_tp = jnp.where(entered, state.pending_tp, state.bracket_tp)
+    flat = new_state.pos == 0
+    return new_state._replace(
+        pending_active=jnp.zeros_like(state.pending_active),
+        pending_target=jnp.zeros_like(state.pending_target),
+        pending_sl=jnp.zeros_like(state.pending_sl),
+        pending_tp=jnp.zeros_like(state.pending_tp),
+        bracket_sl=jnp.where(flat, 0.0, bracket_sl),
+        bracket_tp=jnp.where(flat, 0.0, bracket_tp),
+    )
+
+
+def check_brackets(
+    state: EnvState, open_price, high, low, cfg: EnvConfig, params: EnvParams
+) -> EnvState:
+    """Resolve SL/TP exits intrabar against the bar's H/L."""
+    pos = state.pos
+    has_pos = pos != 0
+    long = pos > 0
+    sl = state.bracket_sl
+    tp = state.bracket_tp
+    has_sl = sl > 0
+    has_tp = tp > 0
+
+    # trigger + raw fill price per side (stop orders gap-fill at open)
+    sl_trig = has_pos & has_sl & jnp.where(long, low <= sl, high >= sl)
+    tp_trig = has_pos & has_tp & jnp.where(long, high >= tp, low <= tp)
+    sl_fill = jnp.where(
+        long,
+        jnp.where(open_price <= sl, open_price, sl),
+        jnp.where(open_price >= sl, open_price, sl),
+    )
+    tp_fill = jnp.where(
+        long,
+        jnp.where(open_price >= tp, open_price, tp),
+        jnp.where(open_price <= tp, open_price, tp),
+    )
+
+    if cfg.intrabar_collision_policy == "ohlc":
+        # Walk the O->H->L->C path.  A bar that opens through either
+        # bracket fills it at the open (gap_sl and gap_tp are mutually
+        # exclusive: SL and TP sit on opposite sides of the entry).
+        # With no gap, longs reach TP on the O->H leg before SL on H->L;
+        # shorts reach SL (above) on the O->H leg before TP on H->L.
+        gap_sl = has_pos & has_sl & jnp.where(long, open_price <= sl, open_price >= sl)
+        gap_tp = has_pos & has_tp & jnp.where(long, open_price >= tp, open_price <= tp)
+        exit_sl = gap_sl | (
+            sl_trig & ~gap_tp & jnp.where(long, ~tp_trig, jnp.ones_like(gap_sl))
+        )
+        exit_tp = (gap_tp | tp_trig) & ~exit_sl
+    else:  # worst_case / adaptive
+        exit_sl = sl_trig
+        exit_tp = tp_trig & ~sl_trig
+
+    exiting = exit_sl | exit_tp
+    # SL exits suffer adverse slippage (stop -> market); TP exits fill at
+    # the limit price exactly (a limit cannot fill worse than its price).
+    exit_dir = -jnp.sign(pos)  # sell to exit long, buy to exit short
+    raw_price = jnp.where(exit_sl, sl_fill, tp_fill)
+    # apply_fill applies params.slippage itself; neutralize for TP by
+    # pre-adjusting the price so the post-slippage fill equals the limit.
+    denom = 1.0 + params.slippage * exit_dir
+    adj_price = jnp.where(
+        exit_sl, raw_price, raw_price / jnp.where(denom == 0, 1.0, denom)
+    )
+
+    target = jnp.where(exiting, 0.0, pos)
+    new_state = apply_fill(state, jnp.where(exiting, adj_price, open_price), target, params)
+    return new_state._replace(
+        bracket_sl=jnp.where(exiting, 0.0, state.bracket_sl),
+        bracket_tp=jnp.where(exiting, 0.0, state.bracket_tp),
+    )
+
+
+def mark_to_market(state: EnvState, close_price, params: EnvParams) -> EnvState:
+    """Mark equity at the bar close; update drawdown tracking."""
+    equity_delta = state.cash_delta + state.pos * close_price
+    peak = jnp.maximum(state.peak_equity_delta, equity_delta)
+    money_down = peak - equity_delta
+    peak_equity = params.initial_cash + peak
+    pct_down = jnp.where(peak_equity > 0, money_down / peak_equity * 100.0, 0.0)
+    return state._replace(
+        prev_equity_delta=state.equity_delta,
+        equity_delta=equity_delta,
+        peak_equity_delta=peak,
+        max_drawdown_money=jnp.maximum(state.max_drawdown_money, money_down),
+        max_drawdown_pct=jnp.maximum(state.max_drawdown_pct, pct_down),
+    )
+
+
+def equity(state: EnvState, params: EnvParams):
+    return params.initial_cash + state.equity_delta
+
+
+def prev_equity(state: EnvState, params: EnvParams):
+    return params.initial_cash + state.prev_equity_delta

@@ -1,0 +1,235 @@
+"""The functional environment: ``reset`` / ``step`` as pure JAX.
+
+One ``step`` fuses what the reference spreads across two threads and a
+per-bar Event handshake (reference app/env.py:279-328 on the main
+thread, app/bt_bridge.py:136-248 on the cerebro thread):
+
+  coerce action -> event-context overlay -> diagnostics ->
+  [advance bar: fill pending at open, resolve brackets intrabar,
+   apply strategy at close, mark equity] -> reward -> obs/info
+
+Step/bar timing parity with the reference handshake:
+  * ``reset`` yields the observation at bar_index=1 (first bar
+    processed, warmup publish — reference bt_bridge.py:144-151);
+  * the FIRST ``step`` applies its action on that same bar without
+    advancing (the order fills at bar 2's open);
+  * every subsequent step advances exactly one bar: the previous
+    action's order fills at the new bar's open, brackets resolve
+    against the new bar's H/L, the new action is applied at its close,
+    equity is marked at that close;
+  * a step taken when the final bar was already processed terminates
+    the episode without advancing (reference cerebro stop() path).
+
+Documented divergences from the reference (quirks not reproduced):
+  * ``last_trade_cost`` reports the commissions actually paid during
+    the step; the reference zeroes its accumulator after notification
+    delivery and therefore always publishes 0.0 (bt_bridge.py:175,239-248);
+  * on the terminal exhausted step the sharpe reward buffer is not
+    cleared-and-repopulated (the reference's step-regression reset
+    fires there, sharpe_reward.py:42-45); pnl/dd rewards match exactly.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+from gymfx_tpu.core import broker, rewards, strategy
+from gymfx_tpu.core.obs import build_info, build_obs
+from gymfx_tpu.core.types import (
+    ACTION_DIAG_INDEX,
+    EXEC_DIAG_INDEX,
+    EnvConfig,
+    EnvParams,
+    EnvState,
+    initial_state,
+)
+from gymfx_tpu.data.feed import MarketData
+
+
+def reset(
+    cfg: EnvConfig, params: EnvParams, data: MarketData
+) -> Tuple[EnvState, Dict[str, Any]]:
+    """Start an episode; returns (state, obs) at bar_index=1."""
+    state = initial_state(cfg)
+    state = broker.mark_to_market(state, data.close[0], params)
+    # both prev and current equity are initial cash at the warmup publish
+    state = state._replace(prev_equity_delta=state.equity_delta)
+    return state, build_obs(state, data, cfg, params)
+
+
+def step(
+    cfg: EnvConfig,
+    params: EnvParams,
+    data: MarketData,
+    state: EnvState,
+    action,
+) -> Tuple[EnvState, Dict[str, Any], Any, Any, Dict[str, Any]]:
+    """Pure step. Returns (state, obs, reward, done, info)."""
+    n = cfg.n_bars
+    was_terminated = state.terminated
+
+    # ---- action coercion (reference app/env.py:343-360) ------------------
+    raw = jnp.asarray(action).reshape(-1)[0].astype(state.pos.dtype)
+    if cfg.action_space_mode == "continuous":
+        thr = params.continuous_action_threshold
+        a = jnp.where(raw >= thr, 1, jnp.where(raw <= -thr, 2, 0)).astype(jnp.int32)
+    else:
+        ai = jnp.asarray(action).reshape(-1)[0].astype(jnp.int32)
+        a = jnp.where((ai >= 0) & (ai <= 2), ai, 0)
+
+    # ---- event-context overlay (reference app/env.py:394-440) ------------
+    a, state, event_info = _event_overlay(state, a, data, cfg, params)
+
+    # ---- action diagnostics (post-overlay, reference app/env.py:287) -----
+    state = _record_action(state, raw, a, cfg)
+
+    # ---- engine advance ---------------------------------------------------
+    live = ~was_terminated
+    advance = live & state.started & (state.t < n - 1)
+    exhausted = live & state.started & (state.t >= n - 1)
+    act_strategy = live & ~exhausted          # warmup or advancing step
+
+    t_new = jnp.where(advance, state.t + 1, state.t)
+    o = data.open[t_new]
+    h = data.high[t_new]
+    l = data.low[t_new]
+    c = data.close[t_new]
+    mow = data.minute_of_week[t_new]
+
+    st = state._replace(t=t_new, last_trade_cost=jnp.zeros_like(state.last_trade_cost))
+
+    # 1. pending order fills at the new bar's open (only when advancing)
+    st_f = broker.fill_pending(st, o, params)
+    st = _select(advance, st_f, st)
+    # 2. brackets resolve against the new bar's H/L
+    st_b = broker.check_brackets(st, o, h, l, cfg, params)
+    st = _select(advance, st_b, st)
+    # 3. strategy applies the (post-overlay) action at the bar close
+    st = strategy.apply_action(st, a, o, h, l, c, mow, cfg, params, act_strategy)
+    # 4. mark equity at the close (advancing bars only; the warmup step
+    #    re-marks bar 0, which is a no-op on an untouched ledger)
+    st_m = broker.mark_to_market(st, c, params)
+    st = _select(advance | (live & ~state.started), st_m, st)
+
+    st = st._replace(started=state.started | live)
+
+    # ---- reward -----------------------------------------------------------
+    st, base_reward = rewards.compute_reward(st, cfg, params, live)
+    fc_row = jnp.minimum(st.t + 1, n - 1)
+    penalty = rewards.force_close_penalty(
+        st, data.force_close[fc_row], cfg, params
+    )
+    penalty = jnp.where(live, penalty, 0.0)
+    reward = base_reward - penalty
+
+    # ---- termination ------------------------------------------------------
+    equity = params.initial_cash + st.equity_delta
+    broke = equity <= params.min_equity
+    terminated = was_terminated | exhausted | (live & broke)
+    st = st._replace(terminated=terminated)
+
+    obs = build_obs(st, data, cfg, params)
+    info = build_info(st, data, cfg, params, event_info)
+    info["reward"] = reward
+    info["base_reward"] = base_reward
+    info["force_close_reward_penalty"] = penalty
+    info["pnl"] = st.equity_delta - st.prev_equity_delta
+    info["trade_cost"] = st.last_trade_cost
+    return st, obs, reward, terminated, info
+
+
+# ---------------------------------------------------------------------------
+def _select(pred, a: EnvState, b: EnvState) -> EnvState:
+    return EnvState(*(jnp.where(pred, x, y) for x, y in zip(a, b)))
+
+
+def _event_overlay(state, a, data: MarketData, cfg: EnvConfig, params: EnvParams):
+    """Event-context action transform (reference app/env.py:362-440).
+
+    Reads engineered no-trade columns at the upcoming row and blocks new
+    entries / force-flattens open positions during event windows."""
+    n = cfg.n_bars
+    row = jnp.minimum(jnp.minimum(state.t + 1, n), n - 1)
+    no_trade_value = data.ev_no_trade[row]
+    spread_mult = data.ev_spread_mult[row]
+    slip_mult = data.ev_slip_mult[row]
+    active = no_trade_value >= params.event_no_trade_threshold
+    pos_sign = jnp.sign(state.pos).astype(jnp.int32)
+    before = a
+
+    if cfg.event_context_execution_overlay:
+        diag = state.exec_diag
+        diag = diag.at[EXEC_DIAG_INDEX["event_context_no_trade_active_steps"]].add(
+            active.astype(jnp.int32)
+        )
+        forced_flat = (
+            active & jnp.asarray(cfg.event_context_force_flat) & (pos_sign != 0)
+        )
+        blocked = (
+            active
+            & ~forced_flat
+            & jnp.asarray(cfg.event_context_block_new_entries)
+            & (pos_sign == 0)
+            & ((before == 1) | (before == 2))
+        )
+        after = jnp.where(forced_flat, 3, jnp.where(blocked, 0, before))
+        overridden = after != before
+        diag = diag.at[EXEC_DIAG_INDEX["event_context_action_overrides"]].add(
+            overridden.astype(jnp.int32)
+        )
+        diag = diag.at[EXEC_DIAG_INDEX["event_context_blocked_entries"]].add(
+            blocked.astype(jnp.int32)
+        )
+        diag = diag.at[EXEC_DIAG_INDEX["event_context_forced_flat_actions"]].add(
+            forced_flat.astype(jnp.int32)
+        )
+        state = state._replace(exec_diag=diag)
+    else:
+        forced_flat = jnp.zeros_like(active)
+        blocked = jnp.zeros_like(active)
+        after = before
+
+    event_info = {
+        "event_context_no_trade_value": no_trade_value,
+        "event_context_no_trade_active": active.astype(jnp.float32),
+        "event_context_spread_stress_multiplier": spread_mult,
+        "event_context_slippage_stress_multiplier": slip_mult,
+        "event_context_execution_overlay": jnp.asarray(
+            cfg.event_context_execution_overlay
+        ),
+        "event_context_action_before_overlay": before,
+        "event_context_action_after_overlay": after,
+        "event_context_action_overridden": after != before,
+        "event_context_blocked_entry": blocked,
+        "event_context_forced_flat": forced_flat,
+        "event_context_position_before_overlay": pos_sign,
+    }
+    return after, state, event_info
+
+
+def _record_action(state: EnvState, raw, a, cfg: EnvConfig) -> EnvState:
+    """Per-episode action counters (reference app/env.py:744-761)."""
+    diag = state.action_diag
+    diag = diag.at[ACTION_DIAG_INDEX["steps"]].add(1)
+    is_long = a == 1
+    is_short = a == 2
+    is_hold = ~is_long & ~is_short
+    diag = diag.at[ACTION_DIAG_INDEX["long_actions"]].add(is_long.astype(jnp.int32))
+    diag = diag.at[ACTION_DIAG_INDEX["short_actions"]].add(is_short.astype(jnp.int32))
+    diag = diag.at[ACTION_DIAG_INDEX["non_hold_actions"]].add(
+        (is_long | is_short).astype(jnp.int32)
+    )
+    diag = diag.at[ACTION_DIAG_INDEX["hold_actions"]].add(is_hold.astype(jnp.int32))
+    if cfg.action_space_mode == "continuous":
+        diag = diag.at[ACTION_DIAG_INDEX["continuous_deadband_actions"]].add(
+            is_hold.astype(jnp.int32)
+        )
+    return state._replace(
+        action_diag=diag,
+        raw_abs_sum=state.raw_abs_sum + jnp.abs(raw),
+        raw_min=jnp.minimum(state.raw_min, raw),
+        raw_max=jnp.maximum(state.raw_max, raw),
+        last_raw_action=raw,
+        last_coerced_action=a.astype(jnp.int32),
+    )

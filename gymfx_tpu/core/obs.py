@@ -1,0 +1,157 @@
+"""Observation / info assembly (pure, static-structure dicts).
+
+Obs blocks and semantics mirror the reference Dict observation space
+(reference app/env.py:31-90 and the preprocessor family):
+  features   (window, n_features) leakage-safe scaled feature window
+             (reference preprocessor_plugins/feature_window_preprocessor.py)
+  prices     (window,) close window, front-padded with the first value
+  returns    (window,) first differences, 0 for the first element
+             (reference preprocessor_plugins/default_preprocessor.py:47-53)
+  position / equity_norm / unrealized_pnl_norm / steps_remaining_norm
+             (1,) agent-state scalars
+plus the optional stage-B force-close block (reference app/env.py:480-486)
+and the OANDA calendar block (reference app/env.py:487-507).
+
+Indexing parity note: the window at step ``t`` covers rows
+[bar_index - window, bar_index) where bar_index = t+1 (the current row
+inclusive), while calendar/force-close/event features are read at row
+min(bar_index, n-1) — one bar ahead, the bar the pending action will
+trade on — exactly as the reference indexes them
+(reference app/env.py:465,481,489,369).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+from gymfx_tpu.data.calendar import CALENDAR_FEATURE_KEYS, FORCE_CLOSE_FEATURE_KEYS
+from gymfx_tpu.data.feed import MarketData
+from gymfx_tpu.core.types import (
+    ACTION_DIAG_KEYS,
+    EXEC_DIAG_KEYS,
+    EnvConfig,
+    EnvParams,
+    EnvState,
+)
+
+# Calendar obs keys exclude is_no_trade_window (info-only in the obs dict,
+# reference app/env.py:490-501) and add the two margin placeholders.
+CALENDAR_OBS_KEYS = tuple(k for k in CALENDAR_FEATURE_KEYS if k != "is_no_trade_window")
+
+
+def build_obs(
+    state: EnvState, data: MarketData, cfg: EnvConfig, params: EnvParams
+) -> Dict[str, Any]:
+    w = cfg.window_size
+    n = cfg.n_bars
+    step = jnp.minimum(state.t + 1, n)  # == bar_index, clamped
+    obs: Dict[str, Any] = {}
+
+    if cfg.n_features > 0:
+        win = lax.dynamic_slice(
+            data.padded_features, (step, 0), (w, cfg.n_features)
+        )
+        mean = data.feat_mean[step]
+        std = data.feat_std[step]
+        neutral = data.feat_neutral[step]
+        scaled = jnp.where(neutral, 0.0, (win - mean) / std)
+        if any(cfg.binary_mask):
+            mask = jnp.asarray(cfg.binary_mask, dtype=bool)
+            scaled = jnp.where(mask[None, :], win, scaled)
+        clip = cfg.feature_clip
+        if clip and clip > 0:
+            scaled = jnp.clip(scaled, -clip, clip)
+        scaled = jnp.nan_to_num(
+            scaled, nan=0.0, posinf=clip or 0.0, neginf=-(clip or 0.0)
+        )
+        obs["features"] = scaled.astype(jnp.float32)
+
+    price = data.close[state.t]
+    prices = None
+    if cfg.include_prices:
+        prices = lax.dynamic_slice(data.padded_close, (step,), (w,))
+        returns = prices - jnp.concatenate([prices[:1], prices[:-1]])
+        obs["prices"] = prices.astype(jnp.float32)
+        obs["returns"] = returns.astype(jnp.float32)
+
+    if cfg.include_agent_state:
+        initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
+        pos_sign = jnp.sign(state.pos)
+        ref_price = prices[-1] if prices is not None else price
+        unrealized = pos_sign * (price - ref_price) * params.position_size
+        obs["position"] = jnp.asarray([pos_sign], dtype=jnp.float32)
+        obs["equity_norm"] = jnp.asarray(
+            [state.equity_delta / initial], dtype=jnp.float32
+        )
+        obs["unrealized_pnl_norm"] = jnp.asarray(
+            [unrealized / initial], dtype=jnp.float32
+        )
+        remaining = jnp.maximum(0, n - (state.t + 1)) / max(1, n)
+        obs["steps_remaining_norm"] = jnp.asarray([remaining], dtype=jnp.float32)
+
+    row = jnp.minimum(step, n - 1)
+    if cfg.stage_b_force_close_obs:
+        fc = data.force_close[row]
+        for i, key in enumerate(FORCE_CLOSE_FEATURE_KEYS):
+            obs[key] = fc[i][None]
+
+    if cfg.oanda_fx_calendar_obs:
+        cal = data.calendar[row]
+        cal_map = dict(zip(CALENDAR_FEATURE_KEYS, cal))
+        for key in CALENDAR_OBS_KEYS:
+            obs[key] = cal_map[key][None]
+        initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
+        obs["margin_closeout_percent"] = jnp.zeros((1,), dtype=jnp.float32)
+        obs["margin_available_norm"] = jnp.asarray(
+            [(params.initial_cash + state.equity_delta) / initial],
+            dtype=jnp.float32,
+        )
+    return obs
+
+
+def build_info(
+    state: EnvState,
+    data: MarketData,
+    cfg: EnvConfig,
+    params: EnvParams,
+    event_info: Dict[str, Any] | None = None,
+) -> Dict[str, Any]:
+    n = cfg.n_bars
+    info: Dict[str, Any] = {
+        "equity": params.initial_cash + state.equity_delta,
+        "position": jnp.sign(state.pos).astype(jnp.int32),
+        "price": data.close[state.t],
+        "bar_index": state.t + 1,
+        "total_bars": jnp.asarray(n, dtype=jnp.int32),
+        "trades": state.trade_count,
+        "commission_paid": state.commission_paid,
+        "raw_action_value": state.last_raw_action,
+        "coerced_action": state.last_coerced_action,
+    }
+    for i, key in enumerate(ACTION_DIAG_KEYS):
+        info[f"action_diagnostics/{key}"] = state.action_diag[i]
+    info["action_diagnostics/raw_abs_sum"] = state.raw_abs_sum
+    info["action_diagnostics/raw_min"] = state.raw_min
+    info["action_diagnostics/raw_max"] = state.raw_max
+    for i, key in enumerate(EXEC_DIAG_KEYS):
+        info[f"execution_diagnostics/{key}"] = state.exec_diag[i]
+    if event_info:
+        info.update(event_info)
+
+    row = jnp.minimum(jnp.minimum(state.t + 1, n), n - 1)
+    if cfg.stage_b_force_close_obs:
+        fc = data.force_close[row]
+        for i, key in enumerate(FORCE_CLOSE_FEATURE_KEYS):
+            info[key] = fc[i]
+    if cfg.oanda_fx_calendar_obs:
+        cal = data.calendar[row]
+        for i, key in enumerate(CALENDAR_FEATURE_KEYS):
+            info[key] = cal[i]
+        initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
+        info["margin_closeout_percent"] = jnp.zeros((), dtype=jnp.float32)
+        info["margin_available_norm"] = (
+            params.initial_cash + state.equity_delta
+        ) / initial
+    return info

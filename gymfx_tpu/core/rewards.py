@@ -1,0 +1,107 @@
+"""Reward kernels with explicit carried state.
+
+The reference reward plugins are stateful Python objects (deque /
+peak-equity attributes) that detect episode resets by step-index
+regression (reference reward_plugins/sharpe_reward.py:42-45,
+dd_penalized_reward.py:38-39).  Here the state is explicit in
+``EnvState`` (ring buffer / scalar carries) and reset happens in
+``reset()`` — no detection tricks needed under ``lax.scan``.
+
+Kernels (selected statically by EnvConfig.reward):
+  pnl_reward           (new-prev)/initial_cash * reward_scale
+                       (reference reward_plugins/pnl_reward.py:26-36)
+  sharpe_reward        annualized rolling Sharpe of normalized step
+                       returns; warmup (<2 samples) -> 0
+                       (reference reward_plugins/sharpe_reward.py:37-58)
+  dd_penalized_reward  pnl_norm - lambda * drawdown_norm with running
+                       peak (reference reward_plugins/dd_penalized_reward.py:31-47)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from gymfx_tpu.core.types import EnvConfig, EnvParams, EnvState
+
+
+def compute_reward(
+    state: EnvState, cfg: EnvConfig, params: EnvParams, active
+):
+    """Return (new_state, base_reward).  ``active`` masks carry updates
+    (terminated steps must not mutate reward state)."""
+    # Work in equity-delta space: (initial + delta) - (initial + delta')
+    # in f32 quantizes at ~1e-3 on a 10k account and destroys the ~1e-7
+    # per-step normalized returns; the deltas carry full precision.
+    initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
+    r_norm = (state.equity_delta - state.prev_equity_delta) / initial
+
+    if cfg.reward == "pnl_reward":
+        return state, jnp.where(active, r_norm * params.reward_scale, 0.0)
+
+    if cfg.reward == "sharpe_reward":
+        buf = jnp.where(
+            active,
+            state.reward_buffer.at[state.reward_buffer_idx].set(
+                r_norm.astype(state.reward_buffer.dtype)
+            ),
+            state.reward_buffer,
+        )
+        idx = jnp.where(
+            active, (state.reward_buffer_idx + 1) % cfg.sharpe_window,
+            state.reward_buffer_idx,
+        )
+        n = jnp.where(
+            active,
+            jnp.minimum(state.reward_buffer_len + 1, cfg.sharpe_window),
+            state.reward_buffer_len,
+        )
+        nf = jnp.maximum(n, 1).astype(buf.dtype)
+        mean = jnp.sum(buf) / nf
+        # sample variance (ddof=1), over the n live slots (empty slots are 0)
+        var = (jnp.sum(buf**2) - nf * mean**2) / jnp.maximum(nf - 1, 1)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        sharpe = jnp.where(
+            (n >= 2) & (std > 0),
+            mean / jnp.where(std > 0, std, 1.0)
+            * jnp.sqrt(params.annualization_factor),
+            0.0,
+        )
+        new_state = state._replace(
+            reward_buffer=buf, reward_buffer_idx=idx, reward_buffer_len=n
+        )
+        return new_state, jnp.where(active, sharpe, 0.0)
+
+    # dd_penalized_reward — peak tracked in delta space (initialized to
+    # -inf, standing in for the reference's raw peak of 0.0, which only
+    # differs when equity goes negative; the peak>0 gate covers that).
+    peak = jnp.where(
+        active,
+        jnp.maximum(
+            state.reward_peak,
+            jnp.maximum(state.equity_delta, state.prev_equity_delta),
+        ),
+        state.reward_peak,
+    )
+    peak_positive = (params.initial_cash + peak) > 0
+    dd_norm = jnp.where(peak_positive, (peak - state.equity_delta) / initial, 0.0)
+    reward = r_norm - params.penalty_lambda * dd_norm
+    return state._replace(reward_peak=peak), jnp.where(active, reward, 0.0)
+
+
+def force_close_penalty(
+    state: EnvState, fc_features, cfg: EnvConfig, params: EnvParams
+):
+    """Stage-B late-Friday exposure penalty (reference app/env.py:639-665)."""
+    if not (cfg.stage_b_force_close_obs and cfg.stage_b_force_close_reward_penalty):
+        return jnp.zeros_like(state.equity_delta)
+    hours_to_fc = fc_features[1]
+    in_zone = fc_features[2] > 0
+    in_window = (hours_to_fc >= 0.0) & (
+        hours_to_fc <= jnp.maximum(params.force_close_penalty_window_hours, 0.0)
+    )
+    applies = (
+        (params.force_close_penalty_coef > 0)
+        & (state.pos != 0)
+        & (in_zone | in_window)
+    )
+    # |position| in the reference is the -1/0/+1 bridge sign -> 1 when open
+    return jnp.where(applies, params.force_close_penalty_coef, 0.0)

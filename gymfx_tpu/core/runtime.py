@@ -1,0 +1,110 @@
+"""Host-side runtime: merged config dict -> bound functional environment.
+
+``Environment`` resolves the dataset once, builds the static EnvConfig,
+numeric EnvParams and device MarketData, and exposes jitted
+reset/step/rollout.  This is the seam between the gym-fx-compatible
+config surface and the pure-JAX core.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gymfx_tpu.core import env as env_core
+from gymfx_tpu.core import rollout as rollout_mod
+from gymfx_tpu.core.types import (
+    EnvConfig,
+    EnvParams,
+    EnvState,
+    make_env_config,
+    make_env_params,
+)
+from gymfx_tpu.data.feed import MarketData, MarketDataset, load_market_dataset
+
+
+class Environment:
+    def __init__(self, config: Dict[str, Any], dataset: Optional[MarketDataset] = None):
+        self.config = dict(config)
+        self.dataset = dataset or load_market_dataset(self.config)
+        if len(self.dataset) < int(config.get("window_size", 32)) + 2:
+            raise ValueError(
+                "input data is empty or too short for the configured window"
+            )
+
+        feature_columns = list(config.get("feature_columns") or [])
+        binary_cols = set(config.get("feature_binary_columns") or [])
+        binary_mask = tuple(c in binary_cols for c in feature_columns)
+
+        self.cfg: EnvConfig = make_env_config(
+            self.config,
+            n_bars=len(self.dataset),
+            n_features=len(feature_columns),
+            binary_mask=binary_mask,
+        )
+        self.params: EnvParams = make_env_params(self.config, self.cfg)
+        self.data: MarketData = self.dataset.build_market_data(
+            window_size=self.cfg.window_size,
+            feature_columns=feature_columns,
+            feature_scaling=str(config.get("feature_scaling", "rolling_zscore")),
+            feature_scaling_window=int(config.get("feature_scaling_window", 256)),
+            dtype=self.cfg.dtype,
+            event_context_no_trade_column=str(
+                config.get("event_context_no_trade_column", "event_no_trade_window_active")
+            ),
+            event_context_spread_stress_column=str(
+                config.get("event_context_spread_stress_column", "event_spread_stress_multiplier")
+            ),
+            event_context_slippage_stress_column=str(
+                config.get("event_context_slippage_stress_column", "event_slippage_stress_multiplier")
+            ),
+            force_close_dow=int(config.get("force_close_dow", 4)),
+            force_close_hour=int(config.get("force_close_hour", 20)),
+            force_close_window_hours=int(config.get("force_close_window_hours", 4)),
+            monday_entry_window_hours=int(config.get("monday_entry_window_hours", 4)),
+        )
+        self._jit_reset = jax.jit(partial(env_core.reset, self.cfg))
+        self._jit_step = jax.jit(partial(env_core.step, self.cfg))
+
+    # ------------------------------------------------------------------
+    @property
+    def n_bars(self) -> int:
+        return self.cfg.n_bars
+
+    def reset(self, params: Optional[EnvParams] = None):
+        return self._jit_reset(params or self.params, self.data)
+
+    def step(self, state: EnvState, action, params: Optional[EnvParams] = None):
+        return self._jit_step(params or self.params, self.data, state, action)
+
+    def rollout(self, driver, steps: int, seed: int = 0, params=None, collect=True):
+        return rollout_mod.rollout(
+            self.cfg,
+            params or self.params,
+            self.data,
+            driver,
+            int(steps),
+            jax.random.PRNGKey(seed),
+            collect=collect,
+        )
+
+    def make_driver(self, rng: Optional[np.random.Generator] = None):
+        """Driver from config['driver_mode'] (reference driver loop,
+        app/main.py:58-66 + default_strategy.py:44-54)."""
+        mode = str(self.config.get("driver_mode", "buy_hold"))
+        if mode == "replay":
+            path = self.config.get("replay_actions_file")
+            if not path:
+                raise ValueError("driver_mode=replay requires replay_actions_file")
+            import csv
+
+            with open(path, "r", encoding="utf-8") as fh:
+                actions = [int(row.get("action", 0)) for row in csv.DictReader(fh)]
+            return rollout_mod.replay_driver(np.asarray(actions or [0]))
+        try:
+            return rollout_mod.DRIVERS[mode]()
+        except KeyError:
+            raise ValueError(f"unknown driver_mode {mode!r}") from None

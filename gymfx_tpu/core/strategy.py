@@ -1,0 +1,252 @@
+"""Strategy kernels: agent action -> pending orders (branch-free).
+
+Three kernels mirror the reference strategy family:
+  default           long/short/flip/close flow, no brackets
+                    (reference app/bt_bridge.py:175-237)
+  direct_fixed_sltp brackets at fixed +/- pips
+                    (reference strategy_plugins/direct_fixed_sltp.py:51-77)
+  direct_atr_sltp   ATR-scaled brackets with true-range ring buffer,
+                    warmup/entry gating, risk modes, distance clamps,
+                    relative-volume sizing and session/weekend filter
+                    (reference strategy_plugins/direct_atr_sltp.py:133-343)
+
+All orders are *pending*: they execute at the next bar's open (see
+core/broker.py).  The hidden action ``3`` force-flattens
+(reference app/bt_bridge.py:178-188).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from gymfx_tpu.core.types import EXEC_DIAG_INDEX, EnvConfig, EnvParams, EnvState
+
+
+def _inc(diag, key, amount):
+    return diag.at[EXEC_DIAG_INDEX[key]].add(
+        jnp.asarray(amount, dtype=jnp.int32)
+    )
+
+
+def apply_action(
+    state: EnvState,
+    action,                  # i32 in {0,1,2,3} (post-overlay)
+    o, h, l, c,              # current bar OHLC
+    minute_of_week,          # i32, -1 when timestamp invalid
+    cfg: EnvConfig,
+    params: EnvParams,
+    active,                  # bool — whether the strategy acts this step
+) -> EnvState:
+    a = jnp.asarray(action, dtype=jnp.int32)
+    diag = state.exec_diag
+    pos = state.pos
+
+    # --- hidden force-flat action (pre-plugin, reference bt_bridge.py:178) ---
+    force_flat = active & (a == 3) & (pos != 0)
+    diag = _inc(diag, "default_orders_submitted", force_flat)
+    diag = _inc(diag, "event_context_forced_flat_orders", force_flat)
+
+    if cfg.strategy == "direct_atr_sltp":
+        state, diag, pending = _atr_sltp(
+            state, a, o, h, l, c, minute_of_week, cfg, params, diag,
+            active & (a != 3),
+        )
+    elif cfg.strategy == "direct_fixed_sltp":
+        pending = _fixed_sltp(state, a, c, params, active & (a != 3))
+    else:
+        diag, pending = _default_flow(state, a, params, diag, active & (a != 3))
+
+    p_active, p_target, p_sl, p_tp = pending
+    p_active = jnp.where(force_flat, True, p_active)
+    p_target = jnp.where(force_flat, 0.0, p_target)
+    p_sl = jnp.where(force_flat, 0.0, p_sl)
+    p_tp = jnp.where(force_flat, 0.0, p_tp)
+
+    return state._replace(
+        exec_diag=diag,
+        pending_active=p_active,
+        pending_target=p_target.astype(state.pos.dtype),
+        pending_sl=p_sl.astype(state.pos.dtype),
+        pending_tp=p_tp.astype(state.pos.dtype),
+    )
+
+
+# ---------------------------------------------------------------------------
+def _default_flow(state, a, params, diag, act):
+    pos = state.pos
+    size = params.position_size
+    is_entry = act & ((a == 1) | (a == 2))
+    diag = _inc(diag, "entry_actions_seen", is_entry)
+
+    want_long = act & (a == 1)
+    want_short = act & (a == 2)
+    # long: flip from short (2 orders) or open from flat (1); no pyramiding
+    open_long = want_long & (pos <= 0)
+    open_short = want_short & (pos >= 0)
+    orders_long = jnp.where(want_long & (pos < 0), 2, jnp.where(open_long, 1, 0))
+    orders_short = jnp.where(want_short & (pos > 0), 2, jnp.where(open_short, 1, 0))
+    diag = _inc(diag, "default_orders_submitted", orders_long + orders_short)
+
+    submit = open_long | open_short
+    target = jnp.where(open_long, size, jnp.where(open_short, -size, 0.0))
+    zero = jnp.zeros_like(state.pending_sl)
+    return diag, (submit, target, zero, zero)
+
+
+def _fixed_sltp(state, a, c, params, act):
+    pos = state.pos
+    size = params.position_size
+    pip = params.pip_size
+    sl_d = params.sl_pips * pip
+    tp_d = params.tp_pips * pip
+
+    open_long = act & (a == 1) & (pos <= 0)
+    open_short = act & (a == 2) & (pos >= 0)
+    submit = open_long | open_short
+    target = jnp.where(open_long, size, jnp.where(open_short, -size, 0.0))
+    sl = jnp.where(open_long, c - sl_d, jnp.where(open_short, c + sl_d, 0.0))
+    tp = jnp.where(open_long, c + tp_d, jnp.where(open_short, c - tp_d, 0.0))
+    return submit, target, sl, tp
+
+
+def _atr_sltp(state, a, o, h, l, c, mow, cfg, params, diag, act):
+    d = state.pos.dtype
+    pos = state.pos
+
+    # ---- true-range ring buffer (updated every acted bar, even on hold;
+    # reference direct_atr_sltp.py:143-155) -------------------------------
+    has_prev = state.prev_close > 0
+    tr = jnp.where(
+        has_prev,
+        jnp.maximum(
+            h - l, jnp.maximum(jnp.abs(h - state.prev_close), jnp.abs(l - state.prev_close))
+        ),
+        h - l,
+    )
+    buf = jnp.where(
+        act,
+        state.tr_buffer.at[state.tr_idx].set(tr.astype(d)),
+        state.tr_buffer,
+    )
+    tr_idx = jnp.where(act, (state.tr_idx + 1) % cfg.atr_period, state.tr_idx)
+    tr_len = jnp.where(
+        act, jnp.minimum(state.tr_len + 1, cfg.atr_period), state.tr_len
+    )
+    prev_close = jnp.where(act, c.astype(d), state.prev_close)
+    state = state._replace(
+        tr_buffer=buf, tr_idx=tr_idx, tr_len=tr_len, prev_close=prev_close
+    )
+
+    # ---- session/weekend filter (minute-of-week window, reference :320-342)
+    if cfg.session_filter:
+        mow_valid = mow >= 0
+        in_entry = jnp.where(
+            mow_valid,
+            (mow >= params.entry_start_mow) & (mow < params.force_close_mow),
+            True,
+        )
+        in_close_zone = jnp.where(mow_valid, ~in_entry, False)
+    else:
+        in_entry = jnp.ones_like(act)
+        in_close_zone = jnp.zeros_like(act)
+
+    # Force-close bar with an open position: flatten and stop processing
+    # (reference :158-166); a flat position in the close zone still counts
+    # the entry attempt and then blocks on the session filter.
+    session_close = act & in_close_zone & (pos != 0)
+
+    is_entry_action = act & ((a == 1) | (a == 2)) & ~session_close
+    diag = _inc(diag, "entry_actions_seen", is_entry_action)
+
+    if cfg.session_filter:
+        blocked_session = is_entry_action & ~in_entry
+    else:
+        blocked_session = jnp.zeros_like(is_entry_action)
+    diag = _inc(diag, "blocked_session_filter", blocked_session)
+
+    # ---- ATR + gating ----------------------------------------------------
+    ready = tr_len >= cfg.atr_period
+    atr = jnp.where(
+        tr_len > 0, jnp.sum(buf) / jnp.maximum(tr_len, 1).astype(d), 0.0
+    )
+    size = _compute_size(state, c, params, cfg)
+
+    attempt = is_entry_action & ~blocked_session & in_entry
+    blocked_warmup = attempt & ~ready
+    diag = _inc(diag, "blocked_atr_warmup", blocked_warmup)
+    blocked_atr = attempt & ready & (atr <= 0.0)
+    diag = _inc(diag, "blocked_non_positive_atr", blocked_atr)
+    blocked_size = attempt & ready & (atr > 0.0) & (size <= 0.0)
+    diag = _inc(diag, "blocked_non_positive_size", blocked_size)
+    blocked_price = attempt & ready & (atr > 0.0) & (size > 0.0) & (c <= 0.0)
+    diag = _inc(diag, "blocked_non_positive_price", blocked_price)
+    can_trade = attempt & ready & (atr > 0.0) & (size > 0.0) & (c > 0.0)
+
+    # ---- SL/TP geometry (risk modes + clamps, reference :203-247) -------
+    k_sl_eff, k_tp_eff = _effective_sltp_multiples(cfg, params)
+    sl_dist = k_sl_eff * atr
+    tp_dist = k_tp_eff * atr
+    if cfg.sltp_risk_mode == "margin_aware_atr":
+        rel = jnp.maximum(params.rel_volume * params.use_rel_volume, 0.0)
+        max_loss = params.max_planned_loss_fraction
+        cap_on = (max_loss > 0.0) & (rel > 0.0)
+        cap = c * jnp.maximum(max_loss, 0.0) / jnp.maximum(
+            rel * jnp.maximum(params.leverage, 1e-12), 1e-30
+        )
+        sl_dist = jnp.where(cap_on, jnp.minimum(sl_dist, cap), sl_dist)
+    floor = params.min_sltp_frac * c
+    use_floor = params.min_sltp_frac >= 0
+    sl_dist = jnp.where(use_floor, jnp.maximum(sl_dist, floor), sl_dist)
+    tp_dist = jnp.where(use_floor, jnp.maximum(tp_dist, floor), tp_dist)
+    ceil = params.max_sltp_frac * c
+    use_ceil = params.max_sltp_frac >= 0
+    sl_dist = jnp.where(use_ceil, jnp.minimum(sl_dist, ceil), sl_dist)
+    tp_dist = jnp.where(use_ceil, jnp.minimum(tp_dist, ceil), tp_dist)
+    tp_dist = jnp.where(tp_dist >= c, c * 0.5, tp_dist)
+
+    open_long = can_trade & (a == 1) & (pos <= 0)
+    open_short = can_trade & (a == 2) & (pos >= 0)
+    diag = _inc(diag, "entry_orders_submitted", open_long | open_short)
+
+    submit = open_long | open_short | session_close
+    target = jnp.where(
+        session_close,
+        0.0,
+        jnp.where(open_long, size, jnp.where(open_short, -size, 0.0)),
+    )
+    sl = jnp.where(open_long, c - sl_dist, jnp.where(open_short, c + sl_dist, 0.0))
+    tp = jnp.where(open_long, c + tp_dist, jnp.where(open_short, c - tp_dist, 0.0))
+    return state, diag, (submit, target, sl, tp)
+
+
+def _compute_size(state, c, params, cfg):
+    """Order size (reference direct_atr_sltp.py:291-311)."""
+    cash = params.initial_cash + state.cash_delta
+    raw_fx = cash * params.rel_volume * params.leverage
+    raw_notional = jnp.where(c > 0, raw_fx / jnp.maximum(c, 1e-30), 0.0)
+    raw = raw_notional if cfg.size_mode == "notional" else raw_fx
+    sized = jnp.clip(raw, params.min_order_volume, params.max_order_volume)
+    return jnp.where(params.use_rel_volume > 0, sized, params.position_size)
+
+
+def _effective_sltp_multiples(cfg: EnvConfig, params: EnvParams):
+    """Risk-mode SL/TP multiples (reference direct_atr_sltp.py:263-289)."""
+    k_sl = jnp.maximum(params.k_sl, 0.0)
+    k_tp = jnp.maximum(params.k_tp, 0.0)
+    if cfg.sltp_risk_mode not in ("rel_volume_aware_atr", "margin_aware_atr"):
+        return k_sl, k_tp
+    rel = jnp.maximum(params.rel_volume * params.use_rel_volume, 0.0)
+    baseline = jnp.maximum(params.baseline_rel_volume, 0.0)
+    max_rel = jnp.maximum(baseline + 1e-12, params.max_risk_rel_volume)
+    sl_alpha = jnp.clip(params.rel_volume_sl_shrink_alpha, 0.0, 0.95)
+    tp_alpha = jnp.clip(params.rel_volume_tp_shrink_alpha, 0.0, 0.95)
+    min_k_sl = jnp.maximum(params.min_k_sl, 0.0)
+    min_rr = jnp.maximum(params.min_reward_risk_ratio, 0.0)
+
+    progress = jnp.clip((rel - baseline) / (max_rel - baseline), 0.0, 1.0)
+    shrink = rel > baseline
+    k_sl_eff = jnp.where(
+        shrink, jnp.maximum(min_k_sl, k_sl * (1.0 - sl_alpha * progress)), k_sl
+    )
+    k_tp_eff = jnp.where(shrink, k_tp * (1.0 - tp_alpha * progress), k_tp)
+    k_tp_eff = jnp.maximum(k_tp_eff, k_sl_eff * min_rr)
+    return k_sl_eff, k_tp_eff

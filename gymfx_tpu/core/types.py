@@ -1,0 +1,386 @@
+"""Core state/config/param structures for the functional environment.
+
+The reference keeps episode state in a mutable ``BTBridge`` shared
+between two threads (reference app/bt_bridge.py:30-83) plus hidden
+state inside plugin objects (reward deques, ATR buffers).  Here ALL of
+it is one explicit ``EnvState`` pytree threaded through a pure ``step``
+— the precondition for ``jit``/``vmap``/``lax.scan`` and for sharding
+state across a device mesh.
+
+Three-way split:
+  EnvConfig  static python values (hashable) — changing them recompiles.
+  EnvParams  numeric leaves (a pytree) — changing them does NOT recompile;
+             this is what optimizers / PBT sweeps mutate.
+  EnvState   per-episode carry.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Diagnostics counter layouts (int32 vectors in EnvState).
+# Names mirror the reference diagnostics dicts so info/summary emission is
+# key-for-key compatible (reference app/bt_bridge.py:68-83, app/env.py:718-733).
+# ---------------------------------------------------------------------------
+EXEC_DIAG_KEYS = (
+    "entry_actions_seen",
+    "entry_orders_submitted",
+    "blocked_session_filter",
+    "blocked_atr_warmup",
+    "blocked_non_positive_atr",
+    "blocked_non_positive_size",
+    "blocked_non_positive_price",
+    "default_orders_submitted",
+    "plugin_apply_errors",
+    "event_context_no_trade_active_steps",
+    "event_context_action_overrides",
+    "event_context_blocked_entries",
+    "event_context_forced_flat_actions",
+    "event_context_forced_flat_orders",
+)
+EXEC_DIAG_INDEX = {k: i for i, k in enumerate(EXEC_DIAG_KEYS)}
+
+ACTION_DIAG_KEYS = (
+    "steps",
+    "hold_actions",
+    "long_actions",
+    "short_actions",
+    "non_hold_actions",
+    "continuous_deadband_actions",
+)
+ACTION_DIAG_INDEX = {k: i for i, k in enumerate(ACTION_DIAG_KEYS)}
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvConfig:
+    """Static environment configuration (trace-time constants)."""
+
+    window_size: int = 32
+    n_bars: int = 0
+    n_features: int = 0
+    binary_mask: Tuple[bool, ...] = ()
+    feature_clip: float = 10.0
+
+    action_space_mode: str = "discrete"      # discrete | continuous
+    include_prices: bool = True
+    include_agent_state: bool = True
+    stage_b_force_close_obs: bool = False
+    oanda_fx_calendar_obs: bool = False
+
+    event_context_execution_overlay: bool = False
+    event_context_block_new_entries: bool = True
+    event_context_force_flat: bool = False
+
+    strategy: str = "default"                # default | direct_fixed_sltp | direct_atr_sltp
+    session_filter: bool = False
+    sltp_risk_mode: str = "fixed_atr"        # fixed_atr | rel_volume_aware_atr | margin_aware_atr
+    size_mode: str = "fx_units"              # fx_units | notional
+    atr_period: int = 14
+
+    reward: str = "pnl_reward"               # pnl_reward | sharpe_reward | dd_penalized_reward
+    sharpe_window: int = 64
+    stage_b_force_close_reward_penalty: bool = False
+
+    intrabar_collision_policy: str = "worst_case"  # worst_case | adaptive | ohlc
+
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.action_space_mode not in ("discrete", "continuous"):
+            raise ValueError("action_space_mode must be discrete|continuous")
+        if self.strategy not in ("default", "direct_fixed_sltp", "direct_atr_sltp"):
+            raise ValueError(f"unknown strategy kernel {self.strategy!r}")
+        if self.reward not in ("pnl_reward", "sharpe_reward", "dd_penalized_reward"):
+            raise ValueError(f"unknown reward kernel {self.reward!r}")
+
+
+class EnvParams(NamedTuple):
+    """Numeric environment parameters (pytree leaves; no recompilation)."""
+
+    initial_cash: Any
+    position_size: Any
+    commission: Any            # fraction of notional per executed order
+    slippage: Any              # fraction of price per fill
+    leverage: Any
+    min_equity: Any
+    continuous_action_threshold: Any
+
+    # reward family
+    reward_scale: Any
+    penalty_lambda: Any
+    annualization_factor: Any
+
+    # fixed-sltp strategy
+    sl_pips: Any
+    tp_pips: Any
+    pip_size: Any
+
+    # atr-sltp strategy
+    k_sl: Any
+    k_tp: Any
+    use_rel_volume: Any        # 0/1 flag (reference: rel_volume=None disables)
+    rel_volume: Any
+    min_order_volume: Any
+    max_order_volume: Any
+    min_sltp_frac: Any         # <0 disables
+    max_sltp_frac: Any         # <0 disables
+    baseline_rel_volume: Any
+    max_risk_rel_volume: Any
+    rel_volume_sl_shrink_alpha: Any
+    rel_volume_tp_shrink_alpha: Any
+    min_k_sl: Any
+    min_reward_risk_ratio: Any
+    max_planned_loss_fraction: Any  # <0 disables
+
+    # session/weekend filter (minute-of-week bounds)
+    entry_start_mow: Any
+    force_close_mow: Any
+
+    # event-context overlay
+    event_no_trade_threshold: Any
+
+    # stage-B force-close reward penalty
+    force_close_penalty_coef: Any
+    force_close_penalty_window_hours: Any
+
+
+class EnvState(NamedTuple):
+    """Per-episode carry threaded through the scan."""
+
+    t: Any                 # i32 current bar row (0-based); bar_index = t + 1
+    started: Any           # bool — warmup handshake done (reference bt_bridge.py:144-151)
+    terminated: Any        # bool
+
+    # broker ledger (all in quote currency, relative to initial cash)
+    pos: Any               # signed units
+    entry_price: Any       # avg entry price of open position
+    cash_delta: Any        # cash - initial_cash
+    equity_delta: Any      # marked at close of bar t
+    prev_equity_delta: Any
+    commission_paid: Any
+    last_trade_cost: Any
+    trade_count: Any       # i32 closed trades
+
+    # pending order (created at bar t close, fills at bar t+1 open)
+    pending_active: Any    # bool
+    pending_target: Any    # desired signed units
+    pending_sl: Any        # bracket prices to arm after fill (0 = none)
+    pending_tp: Any
+
+    # active bracket on the open position (0 = none)
+    bracket_sl: Any
+    bracket_tp: Any
+
+    # trade statistics (for SQN / won / lost / avg pnl)
+    trade_pnl_sum: Any
+    trade_pnl_sumsq: Any
+    trades_won: Any        # i32
+    trades_lost: Any       # i32
+    open_trade_commission: Any  # commissions attributed to the open trade
+
+    # drawdown tracking
+    peak_equity_delta: Any
+    max_drawdown_money: Any
+    max_drawdown_pct: Any
+
+    # reward carries
+    reward_buffer: Any     # (sharpe_window,) step returns ring buffer
+    reward_buffer_len: Any # i32
+    reward_buffer_idx: Any # i32
+    reward_peak: Any       # dd_penalized peak equity
+
+    # ATR true-range ring buffer (direct_atr_sltp)
+    tr_buffer: Any         # (atr_period,)
+    tr_len: Any            # i32
+    tr_idx: Any            # i32
+    prev_close: Any        # previous bar close (<=0 sentinel: none yet)
+
+    # diagnostics
+    exec_diag: Any         # (len(EXEC_DIAG_KEYS),) i32
+    action_diag: Any       # (len(ACTION_DIAG_KEYS),) i32
+    raw_abs_sum: Any
+    raw_min: Any
+    raw_max: Any
+    last_raw_action: Any
+    last_coerced_action: Any  # i32
+
+
+# ---------------------------------------------------------------------------
+# Builders from a merged config dict
+# ---------------------------------------------------------------------------
+def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
+                    binary_mask: Tuple[bool, ...] = ()) -> EnvConfig:
+    feature_columns = list(config.get("feature_columns") or [])
+    include_prices = bool(config.get("include_price_window", not feature_columns))
+    oanda_cal = bool(
+        config.get("oanda_fx_calendar_obs", False)
+        or str(config.get("broker_profile") or "").lower() == "oanda_us_fx"
+    )
+    dtype = {"float32": jnp.float32, "float64": jnp.float64, "bfloat16": jnp.bfloat16}[
+        str(config.get("compute_dtype", "float32"))
+    ]
+    return EnvConfig(
+        window_size=int(config.get("window_size", 32)),
+        n_bars=int(n_bars),
+        n_features=int(n_features),
+        binary_mask=tuple(binary_mask),
+        feature_clip=float(config.get("feature_clip", 10.0)),
+        action_space_mode=str(config.get("action_space_mode", "discrete")).lower(),
+        include_prices=include_prices,
+        include_agent_state=bool(config.get("include_agent_state", True)),
+        stage_b_force_close_obs=bool(config.get("stage_b_force_close_obs", False)),
+        oanda_fx_calendar_obs=oanda_cal,
+        event_context_execution_overlay=bool(
+            config.get("event_context_execution_overlay", False)
+        ),
+        event_context_block_new_entries=bool(
+            config.get("event_context_block_new_entries", True)
+        ),
+        event_context_force_flat=bool(config.get("event_context_force_flat", False)),
+        strategy=_strategy_kernel_name(config),
+        session_filter=bool(config.get("session_filter", False)),
+        sltp_risk_mode=str(config.get("sltp_risk_mode", "fixed_atr")).lower(),
+        size_mode=str(config.get("size_mode", "fx_units")).lower(),
+        atr_period=int(config.get("atr_period", 14)),
+        reward=str(config.get("reward_plugin", "pnl_reward")),
+        sharpe_window=int(config.get("window", config.get("sharpe_window", 64))),
+        stage_b_force_close_reward_penalty=bool(
+            config.get("stage_b_force_close_reward_penalty", False)
+        ),
+        intrabar_collision_policy=str(
+            config.get("intrabar_collision_policy", "worst_case")
+        ),
+        dtype=dtype,
+    )
+
+
+def _strategy_kernel_name(config: Dict[str, Any]) -> str:
+    name = str(config.get("strategy_plugin", "default_strategy"))
+    if name in ("direct_fixed_sltp", "direct_atr_sltp"):
+        return name
+    return "default"
+
+
+def make_env_params(config: Dict[str, Any], cfg: EnvConfig) -> EnvParams:
+    d = cfg.dtype
+    initial_cash = float(config.get("initial_cash", 10000.0))
+    min_equity = config.get("min_equity")
+    if min_equity is None:
+        min_equity = initial_cash * 0.01  # reference app/env.py:122
+    rel_volume = config.get("rel_volume")
+    use_rel = rel_volume is not None
+
+    def f(x) -> Any:
+        return jnp.asarray(float(x), dtype=d)
+
+    def opt(x, disabled=-1.0) -> Any:
+        return f(disabled if x is None else x)
+
+    slippage = config.get("slippage_perc", config.get("slippage", 0.0)) or 0.0
+    entry_start_mow = (
+        int(config.get("entry_dow_start", 0)) * 24 * 60
+        + int(config.get("entry_hour_start", 12)) * 60
+    )
+    force_close_mow = (
+        int(config.get("force_close_dow", 4)) * 24 * 60
+        + int(config.get("force_close_hour", 20)) * 60
+    )
+    return EnvParams(
+        initial_cash=f(initial_cash),
+        position_size=f(config.get("position_size", 1.0)),
+        commission=f(config.get("commission", 0.0)),
+        slippage=f(slippage),
+        leverage=f(config.get("leverage", 1.0)),
+        min_equity=f(min_equity),
+        continuous_action_threshold=f(
+            0.33
+            if config.get("continuous_action_threshold", 0.33) is None
+            else config.get("continuous_action_threshold", 0.33)
+        ),
+        reward_scale=f(config.get("reward_scale", 1.0)),
+        penalty_lambda=f(config.get("penalty_lambda", 1.0)),
+        annualization_factor=f(config.get("annualization_factor", 252.0)),
+        sl_pips=f(config.get("sl_pips", 20.0)),
+        tp_pips=f(config.get("tp_pips", 40.0)),
+        pip_size=f(config.get("pip_size", 0.0001)),
+        k_sl=f(config.get("k_sl", 2.0)),
+        k_tp=f(config.get("k_tp", 3.0)),
+        use_rel_volume=f(1.0 if use_rel else 0.0),
+        rel_volume=f(rel_volume if use_rel else 0.0),
+        min_order_volume=f(config.get("min_order_volume", 0.0)),
+        max_order_volume=f(config.get("max_order_volume", 1e12)),
+        min_sltp_frac=opt(config.get("min_sltp_frac", 0.001)),
+        max_sltp_frac=opt(config.get("max_sltp_frac", 0.20)),
+        baseline_rel_volume=f(config.get("baseline_rel_volume", 0.05)),
+        max_risk_rel_volume=f(config.get("max_risk_rel_volume", 0.50)),
+        rel_volume_sl_shrink_alpha=f(config.get("rel_volume_sl_shrink_alpha", 0.35)),
+        rel_volume_tp_shrink_alpha=f(config.get("rel_volume_tp_shrink_alpha", 0.20)),
+        min_k_sl=f(config.get("min_k_sl", 1.0)),
+        min_reward_risk_ratio=f(config.get("min_reward_risk_ratio", 1.0)),
+        max_planned_loss_fraction=opt(config.get("max_planned_loss_fraction")),
+        entry_start_mow=jnp.asarray(entry_start_mow, dtype=jnp.int32),
+        force_close_mow=jnp.asarray(force_close_mow, dtype=jnp.int32),
+        event_no_trade_threshold=f(config.get("event_context_no_trade_threshold", 0.5)),
+        force_close_penalty_coef=f(
+            config.get("force_close_exposure_penalty_coef", 0.0)
+        ),
+        force_close_penalty_window_hours=f(
+            config.get(
+                "force_close_exposure_penalty_window_hours",
+                config.get("force_close_window_hours", 4),
+            )
+        ),
+    )
+
+
+def initial_state(cfg: EnvConfig) -> EnvState:
+    d = cfg.dtype
+    z = jnp.zeros((), dtype=d)
+    zi = jnp.zeros((), dtype=jnp.int32)
+
+    return EnvState(
+        t=zi,
+        started=jnp.zeros((), dtype=bool),
+        terminated=jnp.zeros((), dtype=bool),
+        pos=z,
+        entry_price=z,
+        cash_delta=z,
+        equity_delta=z,
+        prev_equity_delta=z,
+        commission_paid=z,
+        last_trade_cost=z,
+        trade_count=zi,
+        pending_active=jnp.zeros((), dtype=bool),
+        pending_target=z,
+        pending_sl=z,
+        pending_tp=z,
+        bracket_sl=z,
+        bracket_tp=z,
+        trade_pnl_sum=z,
+        trade_pnl_sumsq=z,
+        trades_won=zi,
+        trades_lost=zi,
+        open_trade_commission=z,
+        peak_equity_delta=z,
+        max_drawdown_money=z,
+        max_drawdown_pct=z,
+        reward_buffer=jnp.zeros((cfg.sharpe_window,), dtype=d),
+        reward_buffer_len=zi,
+        reward_buffer_idx=zi,
+        reward_peak=jnp.asarray(-np.inf, dtype=d),  # delta-space peak
+        tr_buffer=jnp.zeros((cfg.atr_period,), dtype=d),
+        tr_len=zi,
+        tr_idx=zi,
+        prev_close=jnp.asarray(-1.0, dtype=d),
+        exec_diag=jnp.zeros((len(EXEC_DIAG_KEYS),), dtype=jnp.int32),
+        action_diag=jnp.zeros((len(ACTION_DIAG_KEYS),), dtype=jnp.int32),
+        raw_abs_sum=z,
+        raw_min=jnp.asarray(np.inf, dtype=d),
+        raw_max=jnp.asarray(-np.inf, dtype=d),
+        last_raw_action=z,
+        last_coerced_action=zi,
+    )

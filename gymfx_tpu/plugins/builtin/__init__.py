@@ -1,6 +1,17 @@
 """Built-in plugin registrations.
 
 Importing this package registers the built-in members of all six plugin
-families (reference entry-point groups, setup.py:11-35).  Modules are
-added here as the corresponding family lands.
+families (reference entry-point groups, setup.py:11-35).  A plugin here
+is a factory + a ``plugin_params`` schema; the schema participates in
+the layered config merge exactly like the reference's class-level
+``plugin_params`` (reference app/main.py:27-45), while the compute
+lives in the static kernels under ``gymfx_tpu.core``.
 """
+from gymfx_tpu.plugins.builtin import (  # noqa: F401
+    brokers,
+    data_feeds,
+    metrics,
+    preprocessors,
+    rewards,
+    strategies,
+)

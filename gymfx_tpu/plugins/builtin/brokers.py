@@ -1,0 +1,50 @@
+"""broker.plugins family (reference broker_plugins/).
+
+The broker "build" step in the reference wires a backtrader BackBroker
+(default_broker.py:35-53); here the broker is the XLA ledger kernel in
+core/broker.py, so the plugin's job reduces to its param schema, which
+feeds EnvParams (commission / slippage / leverage / cash).
+"""
+import os
+
+from gymfx_tpu.plugins.registry import register
+
+
+@register(
+    "broker.plugins",
+    "default_broker",
+    plugin_params={
+        "initial_cash": 10000.0,
+        "commission": 0.0,
+        "slippage_perc": 0.0,
+        "leverage": 1.0,
+    },
+)
+def default_broker(config):
+    return dict(config)
+
+
+@register(
+    "broker.plugins",
+    "oanda_broker",
+    plugin_params={
+        "oanda_token": None,
+        "oanda_account_id": None,
+        "oanda_practice": True,
+    },
+)
+def oanda_broker(config):
+    """Live-trading stub, hard-gated exactly like the reference
+    (reference broker_plugins/oanda_broker.py:43-46)."""
+    if os.environ.get("GYMFX_ENABLE_LIVE") != "1":
+        raise RuntimeError(
+            "oanda_broker is a live-trading stub; set GYMFX_ENABLE_LIVE=1 "
+            "to acknowledge. Simulation uses default_broker."
+        )
+    token = config.get("oanda_token") or os.environ.get("OANDA_TOKEN")
+    account = config.get("oanda_account_id") or os.environ.get("OANDA_ACCOUNT_ID")
+    if not token or not account:
+        raise ValueError("oanda_broker requires oanda_token and oanda_account_id")
+    raise NotImplementedError(
+        "live OANDA order routing is not part of the simulation framework"
+    )

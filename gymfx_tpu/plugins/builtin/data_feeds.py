@@ -1,0 +1,19 @@
+"""data_feed.plugins family (reference data_feed_plugins/)."""
+from gymfx_tpu.data.feed import load_market_dataset
+from gymfx_tpu.plugins.registry import register
+
+
+@register(
+    "data_feed.plugins",
+    "default_data_feed",
+    plugin_params={
+        "input_data_file": "examples/data/eurusd_sample.csv",
+        "date_column": "DATE_TIME",
+        "headers": True,
+        "max_rows": None,
+        "price_column": "CLOSE",
+    },
+)
+def default_data_feed(config):
+    """CSV -> MarketDataset (reference default_data_feed.py:36-56)."""
+    return load_market_dataset(config)

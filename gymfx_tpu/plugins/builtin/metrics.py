@@ -1,0 +1,21 @@
+"""metrics.plugins family (reference metrics_plugins/); summarize
+implementations live in gymfx_tpu/metrics.py."""
+from gymfx_tpu.plugins.registry import register
+
+
+@register("metrics.plugins", "default_metrics", plugin_params={})
+def default_metrics(config):
+    from gymfx_tpu.metrics import summarize_default
+
+    return summarize_default
+
+
+@register(
+    "metrics.plugins",
+    "trading_metrics",
+    plugin_params={"risk_lambda": 1.0, "metric_schema": "trading.metrics.v1"},
+)
+def trading_metrics(config):
+    from gymfx_tpu.metrics import summarize_trading
+
+    return summarize_trading

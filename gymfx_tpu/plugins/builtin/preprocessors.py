@@ -1,0 +1,33 @@
+"""preprocessor.plugins family (reference preprocessor_plugins/)."""
+from gymfx_tpu.plugins.registry import register
+
+
+@register(
+    "preprocessor.plugins",
+    "default_preprocessor",
+    plugin_params={
+        "window_size": 32,
+        "price_column": "CLOSE",
+    },
+)
+def default_preprocessor(config):
+    return {"feature_columns": []}
+
+
+@register(
+    "preprocessor.plugins",
+    "feature_window_preprocessor",
+    plugin_params={
+        "window_size": 32,
+        "price_column": "CLOSE",
+        "feature_columns": [],
+        "feature_binary_columns": [],
+        "feature_scaling": "rolling_zscore",
+        "feature_scaling_window": 256,
+        "include_price_window": True,
+        "include_agent_state": True,
+        "feature_clip": 10.0,
+    },
+)
+def feature_window_preprocessor(config):
+    return {"feature_columns": list(config.get("feature_columns") or [])}

@@ -1,0 +1,34 @@
+"""reward.plugins family (reference reward_plugins/); kernels live in
+core/rewards.py and are selected statically via EnvConfig.reward."""
+from gymfx_tpu.plugins.registry import register
+
+
+@register(
+    "reward.plugins",
+    "pnl_reward",
+    plugin_params={"reward_scale": 1.0, "initial_cash": 10000.0},
+)
+def pnl_reward(config):
+    return {"kernel": "pnl_reward"}
+
+
+@register(
+    "reward.plugins",
+    "sharpe_reward",
+    plugin_params={
+        "window": 64,
+        "annualization_factor": 252.0,
+        "initial_cash": 10000.0,
+    },
+)
+def sharpe_reward(config):
+    return {"kernel": "sharpe_reward"}
+
+
+@register(
+    "reward.plugins",
+    "dd_penalized_reward",
+    plugin_params={"penalty_lambda": 1.0, "initial_cash": 10000.0},
+)
+def dd_penalized_reward(config):
+    return {"kernel": "dd_penalized_reward"}

@@ -1,0 +1,77 @@
+"""strategy.plugins family (reference strategy_plugins/).
+
+``default_strategy`` is the diagnostic driver family (buy_hold / random
+/ flat / replay — reference default_strategy.py:44-54); the two
+``direct_*_sltp`` plugins select bracket kernels in core/strategy.py.
+"""
+from gymfx_tpu.plugins.registry import register
+
+
+@register(
+    "strategy.plugins",
+    "default_strategy",
+    plugin_params={
+        "driver_mode": "buy_hold",
+        "replay_actions_file": None,
+        "seed": None,
+    },
+)
+def default_strategy(config):
+    return {"kernel": "default"}
+
+
+@register(
+    "strategy.plugins",
+    "direct_fixed_sltp",
+    plugin_params={
+        "sl_pips": 20.0,
+        "tp_pips": 40.0,
+        "pip_size": 0.0001,
+        "position_size": 1.0,
+    },
+)
+def direct_fixed_sltp(config):
+    return {"kernel": "direct_fixed_sltp"}
+
+
+@register(
+    "strategy.plugins",
+    "direct_atr_sltp",
+    plugin_params={
+        "atr_period": 14,
+        "k_sl": 2.0,
+        "k_tp": 3.0,
+        "position_size": 1.0,
+        "rel_volume": None,
+        "leverage": 1.0,
+        "min_order_volume": 0.0,
+        "max_order_volume": 1e12,
+        "size_mode": "fx_units",
+        "min_sltp_frac": 0.001,
+        "max_sltp_frac": 0.20,
+        "sltp_risk_mode": "fixed_atr",
+        "baseline_rel_volume": 0.05,
+        "max_risk_rel_volume": 0.50,
+        "rel_volume_sl_shrink_alpha": 0.35,
+        "rel_volume_tp_shrink_alpha": 0.20,
+        "min_k_sl": 1.0,
+        "min_reward_risk_ratio": 1.0,
+        "max_planned_loss_fraction": None,
+        "session_filter": False,
+        "entry_dow_start": 0,
+        "entry_hour_start": 12,
+        "force_close_dow": 4,
+        "force_close_hour": 20,
+    },
+)
+def direct_atr_sltp(config):
+    return {"kernel": "direct_atr_sltp"}
+
+
+def hparam_schema():
+    """GA-tunable hyperparameters (reference direct_atr_sltp.py:345-350)."""
+    return [
+        ("atr_period", 7, 30, "int"),
+        ("k_sl", 1.0, 4.0, "float"),
+        ("k_tp", 1.5, 6.0, "float"),
+    ]

@@ -1,0 +1,39 @@
+"""Shared test fixtures: synthetic dataframes -> Environment."""
+import numpy as np
+import pandas as pd
+
+from gymfx_tpu.config import DEFAULT_VALUES, merge_config
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+
+
+def make_df(closes, opens=None, highs=None, lows=None, start="2024-01-01", freq="1min",
+            extra=None):
+    closes = np.asarray(closes, dtype=np.float64)
+    n = len(closes)
+    df = pd.DataFrame(
+        {
+            "DATE_TIME": pd.date_range(start, periods=n, freq=freq),
+            "OPEN": np.asarray(opens, np.float64) if opens is not None else closes,
+            "HIGH": np.asarray(highs, np.float64) if highs is not None else closes,
+            "LOW": np.asarray(lows, np.float64) if lows is not None else closes,
+            "CLOSE": closes,
+            "VOLUME": np.zeros(n),
+        }
+    )
+    if extra:
+        for k, v in extra.items():
+            df[k] = v
+    return df.set_index("DATE_TIME")
+
+
+def make_env(df, **overrides):
+    config = dict(DEFAULT_VALUES)
+    config.update({"window_size": 4, "timeframe": "M1"})
+    config.update(overrides)
+    return Environment(config, dataset=MarketDataset(df, config))
+
+
+def uptrend_df(n=40, start_price=1.1, rate=2e-4):
+    closes = start_price * (1.0 + rate) ** np.arange(n)
+    return make_df(closes, highs=closes + 1e-5, lows=closes - 1e-5)

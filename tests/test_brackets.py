@@ -1,0 +1,171 @@
+"""Bracket (SL/TP) kernels: fixed-pip entries, intrabar resolution,
+collision policies (reference strategy_plugins/direct_fixed_sltp.py and
+the worst-case semantics of simulation_engines/bakeoff.py:116-163)."""
+import numpy as np
+import pytest
+
+from tests.helpers import make_df, make_env
+
+PIP = 0.0001
+
+
+def _bracket_env(highs, lows, closes=None, **over):
+    n = len(highs)
+    closes = np.full(n, 1.1) if closes is None else np.asarray(closes)
+    df = make_df(closes, highs=highs, lows=lows)
+    over.setdefault("strategy_plugin", "direct_fixed_sltp")
+    over.setdefault("sl_pips", 20.0)
+    over.setdefault("tp_pips", 40.0)
+    over.setdefault("pip_size", PIP)
+    return make_env(df, **over)
+
+
+def _run(env, actions):
+    s, _ = env.reset()
+    infos = []
+    for a in actions:
+        s, o, r, d, info = env.step(s, a)
+        infos.append(info)
+    return s, infos
+
+
+def test_long_entry_arms_brackets_and_tp_fills():
+    n = 10
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    highs[2] = 1.1050  # bar 2 reaches TP = 1.1040
+    env = _bracket_env(highs, lows)
+    s, infos = _run(env, [1, 0, 0, 0])
+    # entry at open[1]=1.1 (sl=1.0980 tp=1.1040 from close[0]); TP at bar 2
+    assert int(infos[2]["position"]) == 0
+    assert int(infos[2]["trades"]) == 1
+    assert float(s.trades_won) == 1
+    assert float(s.equity_delta) == pytest.approx(1.1040 - 1.1, abs=1e-6)
+
+
+def test_long_sl_fills_with_loss():
+    n = 10
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    lows[2] = 1.0950  # bar 2 breaches SL = 1.0980
+    env = _bracket_env(highs, lows)
+    s, infos = _run(env, [1, 0, 0, 0])
+    assert int(infos[2]["position"]) == 0
+    assert int(s.trades_lost) == 1
+    assert float(s.equity_delta) == pytest.approx(1.0980 - 1.1, abs=1e-6)
+
+
+def test_worst_case_collision_sl_wins():
+    n = 10
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    highs[2], lows[2] = 1.1050, 1.0950  # both SL and TP touched in bar 2
+    env = _bracket_env(highs, lows)  # default policy worst_case
+    s, infos = _run(env, [1, 0, 0, 0])
+    assert float(s.equity_delta) == pytest.approx(1.0980 - 1.1, abs=1e-6)
+    assert int(s.trades_lost) == 1
+
+
+def test_ohlc_collision_tp_wins_for_long():
+    n = 10
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    highs[2], lows[2] = 1.1050, 1.0950
+    env = _bracket_env(highs, lows, intrabar_collision_policy="ohlc")
+    s, infos = _run(env, [1, 0, 0, 0])
+    # O->H leg reaches TP before the H->L leg reaches SL
+    assert float(s.equity_delta) == pytest.approx(1.1040 - 1.1, abs=1e-6)
+    assert int(s.trades_won) == 1
+
+
+def test_ohlc_collision_sl_wins_for_short():
+    n = 10
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    highs[2], lows[2] = 1.1050, 1.0950  # short SL=1.1020 above, TP=1.1060...
+    env = _bracket_env(highs, lows, intrabar_collision_policy="ohlc",
+                       sl_pips=20.0, tp_pips=40.0)
+    s, infos = _run(env, [2, 0, 0, 0])
+    # short from close[0]=1.1: SL=1.1020, TP=1.0960; bar2 touches both;
+    # the O->H leg hits the SL (above) before the L leg reaches TP
+    assert float(s.equity_delta) == pytest.approx(1.1 - 1.1020, abs=1e-6)
+    assert int(s.trades_lost) == 1
+
+
+def test_gap_through_sl_fills_at_open():
+    n = 10
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    opens = np.full(n, 1.1)
+    opens[2] = 1.0900  # gaps below SL=1.0980
+    lows[2] = 1.0890
+    highs[2] = 1.0910
+    df = make_df(np.full(n, 1.1), opens=opens, highs=highs, lows=lows)
+    env = make_env(df, strategy_plugin="direct_fixed_sltp", sl_pips=20.0,
+                   tp_pips=40.0, pip_size=PIP)
+    s, infos = _run(env, [1, 0, 0, 0])
+    assert float(s.equity_delta) == pytest.approx(1.0900 - 1.1, abs=1e-6)
+
+
+def test_repeated_long_actions_do_not_restack_brackets():
+    n = 12
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    env = _bracket_env(highs, lows)
+    s, infos = _run(env, [1, 1, 1, 1])
+    assert float(np.abs(np.asarray(s.pos))) == 1.0
+    assert int(s.trade_count) == 0
+
+
+def test_atr_warmup_blocks_entries_then_trades():
+    n = 30
+    closes = np.full(n, 1.1)
+    highs = closes + 0.0010
+    lows = closes - 0.0010
+    df = make_df(closes, highs=highs, lows=lows)
+    env = make_env(df, strategy_plugin="direct_atr_sltp", atr_period=5,
+                   k_sl=2.0, k_tp=3.0, min_sltp_frac=None, max_sltp_frac=None)
+    s, infos = _run(env, [1, 1, 1, 1, 1, 1, 1, 0, 0])
+    diag = {k: int(infos[-1][f"execution_diagnostics/{k}"])
+            for k in ("entry_actions_seen", "blocked_atr_warmup",
+                      "entry_orders_submitted")}
+    # TR buffer warms over 5 bars: first 4 entry attempts blocked
+    assert diag["blocked_atr_warmup"] == 4
+    assert diag["entry_orders_submitted"] >= 1
+    assert int(infos[-1]["position"]) == 1
+    # brackets armed at 2*ATR / 3*ATR around the entry close: ATR=0.002
+    assert float(s.bracket_sl) == pytest.approx(1.1 - 2 * 0.002, abs=1e-6)
+    assert float(s.bracket_tp) == pytest.approx(1.1 + 3 * 0.002, abs=1e-6)
+
+
+def test_atr_session_filter_blocks_and_force_closes():
+    # Monday 00:00 start, 1-min bars: entry window starts Monday 12:00.
+    n = 40
+    closes = np.full(n, 1.1)
+    df = make_df(closes, highs=closes + 0.001, lows=closes - 0.001)
+    env = make_env(df, strategy_plugin="direct_atr_sltp", atr_period=3,
+                   session_filter=True, entry_dow_start=0, entry_hour_start=12,
+                   force_close_dow=4, force_close_hour=20)
+    # All bars are Monday 00:00..00:39 — outside the entry window
+    s, infos = _run(env, [1, 1, 1, 1, 1, 1])
+    assert int(infos[-1]["position"]) == 0
+    assert int(infos[-1]["execution_diagnostics/blocked_session_filter"]) >= 1
+
+
+def test_ohlc_short_gap_through_tp_fills_at_open():
+    # Short from close[0]=1.1: SL=1.1020 (above), TP=1.0960 (below).
+    # Bar 2 opens at 1.0900 — gapped through the TP in the short's favor —
+    # then rallies through the SL. The O->H->L->C walk fills the TP at
+    # the open; the SL must NOT claim the exit.
+    n = 10
+    closes = np.full(n, 1.1)
+    opens = np.full(n, 1.1)
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    opens[2], lows[2], highs[2] = 1.0900, 1.0890, 1.1050
+    df = make_df(closes, opens=opens, highs=highs, lows=lows)
+    env = make_env(df, strategy_plugin="direct_fixed_sltp", sl_pips=20.0,
+                   tp_pips=40.0, pip_size=PIP, intrabar_collision_policy="ohlc")
+    s, infos = _run(env, [2, 0, 0, 0])
+    assert int(s.trades_won) == 1
+    assert float(s.equity_delta) == pytest.approx(1.1 - 1.0900, abs=1e-6)

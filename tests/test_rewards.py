@@ -1,0 +1,95 @@
+"""Reward kernels vs direct reimplementation of the reference plugins
+(reference reward_plugins/)."""
+import math
+from collections import deque
+
+import numpy as np
+import pytest
+
+from gymfx_tpu.core import rollout as R
+from tests.helpers import make_df, make_env
+
+
+def _equity_path(env, driver, steps, seed=0):
+    state, out = env.rollout(driver, steps=steps, seed=seed)
+    return (
+        np.asarray(out["equity_delta"], dtype=np.float64) + 10000.0,
+        np.asarray(out["reward"], dtype=np.float64),
+    )
+
+
+def _random_walk_df(n=80, seed=3):
+    rng = np.random.default_rng(seed)
+    closes = 1.1 + np.cumsum(rng.normal(0, 5e-4, n))
+    return make_df(closes, highs=closes + 1e-4, lows=closes - 1e-4)
+
+
+def test_pnl_reward_matches_formula():
+    env = make_env(_random_walk_df(), reward_plugin="pnl_reward", reward_scale=2.0)
+    eq, rewards = _equity_path(env, R.buy_hold_driver(), 40)
+    prev = np.concatenate([[10000.0], eq[:-1]])
+    expected = (eq - prev) / 10000.0 * 2.0
+    np.testing.assert_allclose(rewards, expected, atol=1e-9)
+
+
+def test_sharpe_reward_matches_deque_reference():
+    window = 8
+    env = make_env(_random_walk_df(), reward_plugin="sharpe_reward",
+                   sharpe_window=window, annualization_factor=252.0,
+                   position_size=100.0)
+    eq, rewards = _equity_path(env, R.buy_hold_driver(), 50)
+
+    buf = deque(maxlen=window)
+    prev = 10000.0
+    expected = []
+    for e in eq:
+        r = (e - prev) / 10000.0
+        prev = e
+        buf.append(r)
+        if len(buf) < 2:
+            expected.append(0.0)
+            continue
+        mean = sum(buf) / len(buf)
+        var = sum((x - mean) ** 2 for x in buf) / (len(buf) - 1)
+        std = math.sqrt(var)
+        expected.append((mean / std) * math.sqrt(252.0) if std > 0 else 0.0)
+    np.testing.assert_allclose(rewards, expected, atol=2e-3)
+
+
+def test_dd_penalized_reward_matches_peak_reference():
+    env = make_env(_random_walk_df(), reward_plugin="dd_penalized_reward",
+                   penalty_lambda=0.5, position_size=100.0)
+    eq, rewards = _equity_path(env, R.buy_hold_driver(), 50)
+
+    peak = 0.0
+    prev = 10000.0
+    expected = []
+    for e in eq:
+        peak = max(peak, e, prev)
+        pnl = (e - prev) / 10000.0
+        dd = (peak - e) / 10000.0 if peak > 0 else 0.0
+        expected.append(pnl - 0.5 * dd)
+        prev = e
+    np.testing.assert_allclose(rewards, expected, atol=1e-6)
+
+
+def test_force_close_penalty_applied_when_exposed_on_friday():
+    # Bars on Friday 19:30..20:10 UTC, 1-min: force-close zone from 20:00.
+    n = 45
+    closes = np.full(n, 1.1)
+    df = make_df(closes, highs=closes + 1e-4, lows=closes - 1e-4,
+                 start="2024-01-05 19:30")  # a Friday
+    env = make_env(
+        df,
+        stage_b_force_close_obs=True,
+        stage_b_force_close_reward_penalty=True,
+        force_close_exposure_penalty_coef=0.01,
+        force_close_exposure_penalty_window_hours=1.0,
+    )
+    state, out = env.rollout(R.buy_hold_driver(), steps=40)
+    rewards = np.asarray(out["reward"])
+    # price never moves -> base pnl reward 0; penalty hits once long
+    assert rewards.min() == pytest.approx(-0.01, abs=1e-9)
+    # flat run never pays the penalty
+    state2, out2 = env.rollout(R.flat_driver(), steps=40)
+    np.testing.assert_allclose(np.asarray(out2["reward"]), 0.0, atol=1e-9)
