@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""CLI runner — the reference's env-only runtime surface
+(reference app/main.py:34-96): parse args, merge the layered config,
+instantiate the six plugin families, run the driver loop, write the
+results JSON, optionally save the non-default config, print the summary.
+
+``mode=training`` additionally routes to the PPO trainer (new
+capability; the reference validates the mode but runs the same episode
+loop for all three).
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List
+
+import numpy as np
+
+from gymfx_tpu.config import DEFAULT_VALUES, load_config, merge_config, save_config
+from gymfx_tpu.config.cli import parse_args
+from gymfx_tpu.config.merger import process_unknown_args
+from gymfx_tpu.gym_env import build_environment
+from gymfx_tpu.plugins import get_plugin_params
+
+
+PLUGIN_GROUPS = {
+    "data_feed_plugin": "data_feed.plugins",
+    "broker_plugin": "broker.plugins",
+    "strategy_plugin": "strategy.plugins",
+    "preprocessor_plugin": "preprocessor.plugins",
+    "reward_plugin": "reward.plugins",
+    "metrics_plugin": "metrics.plugins",
+}
+
+
+def _collect_plugin_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
+    merged: Dict[str, Any] = {}
+    for key, group in PLUGIN_GROUPS.items():
+        merged.update(get_plugin_params(group, str(config[key])))
+    return merged
+
+
+def make_cli_driver(config: Dict[str, Any]):
+    """Host-side diagnostic action source
+    (reference strategy_plugins/default_strategy.py:44-54)."""
+    mode = str(config.get("driver_mode", "buy_hold"))
+    seed = config.get("seed")
+    rng = np.random.default_rng(seed)
+    if mode == "replay":
+        path = config.get("replay_actions_file")
+        if not path:
+            raise ValueError("driver_mode=replay requires replay_actions_file")
+        import csv
+
+        with open(path, "r", encoding="utf-8") as fh:
+            actions: List[int] = [int(row.get("action", 0)) for row in csv.DictReader(fh)]
+
+        def replay(obs, info, step):
+            return actions[step] if step < len(actions) else 0
+
+        return replay
+    if mode == "random":
+        return lambda obs, info, step: int(rng.integers(0, 3))
+    if mode == "flat":
+        return lambda obs, info, step: 0
+    if mode == "buy_hold":
+        return lambda obs, info, step: 1 if step == 0 else 0
+    if mode == "policy":
+        raise ValueError(
+            "driver_mode=policy requires a trained policy checkpoint "
+            "(run mode=training first and pass --checkpoint_dir)"
+        )
+    raise ValueError(f"unknown driver_mode {mode!r}")
+
+
+def run_mode(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Dispatch on mode.  ``training`` routes to the PPO trainer when the
+    train package is present; otherwise every mode runs the episode loop
+    (the reference validates the mode but runs the same loop for all
+    three — app/main.py:84)."""
+    if config.get("mode") == "training":
+        try:
+            from gymfx_tpu.train.ppo import train_from_config
+        except ImportError:
+            return _run_env(config)
+        return train_from_config(config)
+    return _run_env(config)
+
+
+def _run_env(config: Dict[str, Any]) -> Dict[str, Any]:
+    # plugin defaults re-merge (lowest precedence — reference main.py:44-46)
+    config = merge_config(config, _collect_plugin_defaults(config), {}, {}, {}, {})
+
+    env = build_environment(config=config)
+    decide = make_cli_driver(config)
+    try:
+        obs, info = env.reset(seed=config.get("seed"))
+        done = False
+        steps = int(config.get("steps", 500))
+        step_count = 0
+        while not done and step_count < steps:
+            action = decide(obs, info, step_count)
+            obs, _, terminated, truncated, info = env.step(action)
+            done = bool(terminated or truncated)
+            step_count += 1
+        return env.summary()
+    finally:
+        env.close()
+
+
+def main(argv=None) -> Dict[str, Any]:
+    args, unknown = parse_args(argv)
+    cli_args = vars(args)
+
+    config = DEFAULT_VALUES.copy()
+    file_config = load_config(args.load_config) if args.load_config else {}
+    unknown_dict = process_unknown_args(unknown)
+    config = merge_config(config, {}, {}, file_config, cli_args, unknown_dict)
+
+    if config.get("mode") not in {"training", "optimization", "inference"}:
+        raise ValueError("mode must be one of training|optimization|inference")
+
+    summary = run_mode(config)
+
+    results_file = Path(config.get("results_file") or "results.json")
+    results_file.parent.mkdir(parents=True, exist_ok=True)
+    with results_file.open("w", encoding="utf-8") as fh:
+        json.dump(summary, fh, indent=2, default=str)
+
+    if config.get("save_config"):
+        save_config(config, config["save_config"])
+
+    if not config.get("quiet_mode", False):
+        print(json.dumps(summary, indent=2, default=str))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

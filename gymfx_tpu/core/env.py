@@ -47,6 +47,17 @@ from gymfx_tpu.core.types import (
 from gymfx_tpu.data.feed import MarketData
 
 
+def jit_reset(cfg, params, data):
+    """Module-level jitted reset — cached across Environment instances
+    (a per-instance jax.jit wrapper would recompile for every env)."""
+    return _JIT_RESET(cfg, params, data)
+
+
+def jit_step(cfg, params, data, state, action):
+    """Module-level jitted step (see jit_reset)."""
+    return _JIT_STEP(cfg, params, data, state, action)
+
+
 def reset(
     cfg: EnvConfig, params: EnvParams, data: MarketData
 ) -> Tuple[EnvState, Dict[str, Any]]:
@@ -136,6 +147,9 @@ def step(
     info["force_close_reward_penalty"] = penalty
     info["pnl"] = st.equity_delta - st.prev_equity_delta
     info["trade_cost"] = st.last_trade_cost
+    # full-precision equity relative to initial cash (info["equity"] is
+    # initial+delta in f32, quantized at ~1e-3 on a 10k account)
+    info["equity_delta"] = st.equity_delta
     return st, obs, reward, terminated, info
 
 
@@ -233,3 +247,9 @@ def _record_action(state: EnvState, raw, a, cfg: EnvConfig) -> EnvState:
         last_raw_action=raw,
         last_coerced_action=a.astype(jnp.int32),
     )
+
+
+import jax as _jax  # noqa: E402
+
+_JIT_RESET = _jax.jit(reset, static_argnums=0)
+_JIT_STEP = _jax.jit(step, static_argnums=0)

@@ -7,11 +7,9 @@ config surface and the pure-JAX core.
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from gymfx_tpu.core import env as env_core
@@ -66,8 +64,6 @@ class Environment:
             force_close_window_hours=int(config.get("force_close_window_hours", 4)),
             monday_entry_window_hours=int(config.get("monday_entry_window_hours", 4)),
         )
-        self._jit_reset = jax.jit(partial(env_core.reset, self.cfg))
-        self._jit_step = jax.jit(partial(env_core.step, self.cfg))
 
     # ------------------------------------------------------------------
     @property
@@ -75,10 +71,12 @@ class Environment:
         return self.cfg.n_bars
 
     def reset(self, params: Optional[EnvParams] = None):
-        return self._jit_reset(params or self.params, self.data)
+        return env_core.jit_reset(self.cfg, params or self.params, self.data)
 
     def step(self, state: EnvState, action, params: Optional[EnvParams] = None):
-        return self._jit_step(params or self.params, self.data, state, action)
+        return env_core.jit_step(
+            self.cfg, params or self.params, self.data, state, action
+        )
 
     def rollout(self, driver, steps: int, seed: int = 0, params=None, collect=True):
         return rollout_mod.rollout(

@@ -17,6 +17,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The sandbox's sitecustomize force-registers a remote TPU (axon) backend
+# that wins over the JAX_PLATFORMS env var; the config update below is
+# what actually pins tests to the local virtual-8-device CPU platform.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pathlib  # noqa: E402

@@ -1,0 +1,71 @@
+"""End-to-end CLI runs (reference app/main.py surface)."""
+import json
+
+import pytest
+
+from gymfx_tpu.app.main import main
+
+SAMPLE = "examples/data/eurusd_sample.csv"
+UPTREND = "examples/data/eurusd_uptrend.csv"
+
+
+def _run(tmp_path, data_file=SAMPLE, *extra):
+    results = tmp_path / "results.json"
+    cfg_out = tmp_path / "config.json"
+    argv = [
+        "--input_data_file", data_file,
+        "--results_file", str(results),
+        "--save_config", str(cfg_out),
+        "--quiet_mode",
+        "--steps", "120",
+        *[str(a) for a in extra],
+    ]
+    summary = main(argv)
+    assert results.exists()
+    on_disk = json.loads(results.read_text())
+    assert on_disk["initial_cash"] == summary["initial_cash"]
+    return summary, json.loads(cfg_out.read_text())
+
+
+def test_cli_buy_hold_run(tmp_path):
+    summary, cfg = _run(tmp_path, UPTREND, "--driver_mode", "buy_hold")
+    assert summary["total_return"] > 0
+    assert cfg["steps"] == 120          # non-default keys persisted
+    assert "mode" not in cfg            # defaults dropped
+
+
+def test_cli_flat_run_zero_return(tmp_path):
+    summary, _ = _run(tmp_path, SAMPLE, "--driver_mode", "flat")
+    assert summary["total_return"] == 0.0
+    assert summary["action_diagnostics"]["hold_actions"] == 120
+
+
+def test_cli_random_seeded_reproducible(tmp_path):
+    s1, _ = _run(tmp_path, SAMPLE, "--driver_mode", "random", "--seed", "5")
+    s2, _ = _run(tmp_path, SAMPLE, "--driver_mode", "random", "--seed", "5")
+    assert s1["final_equity"] == s2["final_equity"]
+    assert s1["action_diagnostics"] == s2["action_diagnostics"]
+
+
+def test_cli_replay_driver(tmp_path):
+    replay = tmp_path / "actions.csv"
+    replay.write_text("action\n1\n0\n0\n2\n0\n")
+    summary, _ = _run(
+        tmp_path, SAMPLE, "--driver_mode", "replay",
+        "--replay_actions_file", str(replay), "--commission", "0.0001",
+    )
+    assert summary["trades_total"] >= 1  # the 1->2 flip closes a trade
+    assert summary["action_diagnostics"]["long_actions"] == 1
+    assert summary["action_diagnostics"]["short_actions"] == 1
+
+
+def test_cli_unknown_args_flow_into_config(tmp_path):
+    summary, cfg = _run(tmp_path, SAMPLE, "--my_custom_knob", "2.5")
+    assert cfg["my_custom_knob"] == 2.5
+
+
+def test_cli_rejects_bad_mode(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"mode": "bogus"}))
+    with pytest.raises(ValueError, match="mode must be"):
+        main(["--load_config", str(bad), "--quiet_mode"])
