@@ -150,6 +150,20 @@ def step(
     # full-precision equity relative to initial cash (info["equity"] is
     # initial+delta in f32, quantized at ~1e-3 on a 10k account)
     info["equity_delta"] = st.equity_delta
+    # order/bracket state for the host-side audit trail (reference
+    # GYMFX_BRACKET_AUDIT JSONL, strategy_plugins/direct_atr_sltp.py:40-50)
+    info["pending_active"] = st.pending_active
+    info["pending_target"] = st.pending_target
+    info["pending_sl"] = st.pending_sl
+    info["pending_tp"] = st.pending_tp
+    info["bracket_sl"] = st.bracket_sl
+    info["bracket_tp"] = st.bracket_tp
+    info["position_units"] = st.pos
+    info["atr"] = jnp.where(
+        st.tr_len > 0,
+        jnp.sum(st.tr_buffer) / jnp.maximum(st.tr_len, 1).astype(st.tr_buffer.dtype),
+        0.0,
+    )
     return st, obs, reward, terminated, info
 
 

@@ -51,7 +51,9 @@ def build_obs(
 
     if cfg.n_features > 0:
         win = lax.dynamic_slice(
-            data.padded_features, (step, 0), (w, cfg.n_features)
+            data.padded_features,
+            (step, jnp.zeros((), dtype=step.dtype)),
+            (w, cfg.n_features),
         )
         mean = data.feat_mean[step]
         std = data.feat_std[step]

@@ -15,6 +15,8 @@ scan kernel IS the simulation engine.
 """
 from __future__ import annotations
 
+import json
+import os
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -127,6 +129,17 @@ class GymFxEnv(gym.Env):
         self._last_info: Dict[str, Any] = {}
         self._equity_trace = []
         self._done_trace = []
+        # Append-only JSONL audit of bracket decisions, gated by the same
+        # env var as the reference (GYMFX_BRACKET_AUDIT,
+        # reference direct_atr_sltp.py:40-50).  Only bracket strategies
+        # audit, as in the reference (the audit lives in the atr plugin;
+        # this framework extends it to direct_fixed_sltp with the same
+        # record schema, atr fields null).
+        self._audit_path = (
+            os.environ.get("GYMFX_BRACKET_AUDIT")
+            if self._env.cfg.strategy in ("direct_fixed_sltp", "direct_atr_sltp")
+            else None
+        )
 
     # ------------------------------------------------------------------
     def reset(self, *, seed: Optional[int] = None, options=None):
@@ -151,7 +164,62 @@ class GymFxEnv(gym.Env):
         self._last_info = py_info
         self._equity_trace.append(float(info["equity_delta"]))
         self._done_trace.append(bool(done))
+        if self._audit_path:
+            self._audit_emit(py_info)
         return self._np_obs(obs), float(reward), bool(done), False, py_info
+
+    def _audit_emit(self, info: Dict[str, Any]) -> None:
+        """Reference-schema audit records (direct_atr_sltp.py:164-168,
+        242-247, 256-261): long_bracket/short_bracket entries with
+        atr/k-multiple fields, session_force_close on session flatten."""
+        if not info.get("pending_active"):
+            return
+        target = float(info.get("pending_target", 0.0))
+        if target == 0.0:
+            # Event-overlay force-flats are not audited in the reference
+            # (action 3 is handled before the plugin, bt_bridge.py:178).
+            if info.get("event_context_forced_flat"):
+                return
+            rec = {
+                "kind": "session_force_close",
+                "entry": info.get("price"),
+                "size": float(info.get("position_units", 0.0)),
+            }
+        else:
+            is_atr = self._env.cfg.strategy == "direct_atr_sltp"
+            from gymfx_tpu.core.strategy import _effective_sltp_multiples
+
+            if is_atr:
+                k_sl_eff, k_tp_eff = _effective_sltp_multiples(
+                    self._env.cfg, self._env.params
+                )
+                atr_fields = {
+                    "atr": float(info.get("atr", 0.0)),
+                    "k_sl_eff": float(k_sl_eff),
+                    "k_tp_eff": float(k_tp_eff),
+                    "sltp_risk_mode": self._env.cfg.sltp_risk_mode,
+                }
+            else:
+                atr_fields = {
+                    "atr": None,
+                    "k_sl_eff": None,
+                    "k_tp_eff": None,
+                    "sltp_risk_mode": None,
+                }
+            rec = {
+                "kind": "long_bracket" if target > 0 else "short_bracket",
+                "entry": info.get("price"),
+                "stop": float(info.get("pending_sl", 0.0)) or None,
+                "limit": float(info.get("pending_tp", 0.0)) or None,
+                "size": abs(target),
+                "bar_index": info.get("bar_index"),
+                **atr_fields,
+            }
+        try:
+            with open(self._audit_path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
 
     def render(self):  # pragma: no cover
         return None

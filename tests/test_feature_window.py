@@ -1,0 +1,155 @@
+"""Feature-window observation path: leakage safety, scaling parity,
+binary passthrough, clip/nan guards, warmup neutrality
+(reference tests/test_feature_window_preprocessor.py patterns, incl.
+the future-poisoning invariance test :113-139)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from gymfx_tpu.core import rollout as R
+from tests.helpers import make_df, make_env
+
+
+def _feature_df(n=60, seed=0, poison_after=None):
+    rng = np.random.default_rng(seed)
+    closes = 1.1 + np.cumsum(rng.normal(0, 1e-4, n))
+    f1 = rng.normal(50.0, 5.0, n)        # large-scale feature
+    f2 = rng.normal(0.0, 1e-3, n)        # small-scale feature
+    b = (rng.random(n) > 0.5).astype(float)  # binary feature
+    if poison_after is not None:
+        f1[poison_after:] = 1e6
+        f2[poison_after:] = 1e6
+        b[poison_after:] = 1e6
+        closes = closes.copy()
+        closes[poison_after:] = 1e6
+    return make_df(closes, extra={"f1": f1, "f2": f2, "b": b})
+
+
+# include_price_window=True mirrors the feature_window_preprocessor's
+# plugin defaults, which the CLI merges into the config (reference
+# feature_window_preprocessor.py plugin_params); without the plugin
+# merge, features-configured runs default to no price block
+# (reference app/env.py:43-45).
+FEATURE_CFG = dict(
+    feature_columns=["f1", "f2", "b"],
+    feature_binary_columns=["b"],
+    feature_scaling="rolling_zscore",
+    feature_scaling_window=16,
+    window_size=8,
+    include_price_window=True,
+)
+
+
+def _obs_at_step(df, k, **over):
+    cfg = dict(FEATURE_CFG)
+    cfg.update(over)
+    env = make_env(df, **cfg)
+    s, obs = env.reset()
+    for _ in range(k):
+        s, obs, r, d, info = env.step(s, 0)
+    return {key: np.asarray(v) for key, v in obs.items()}
+
+
+def test_feature_block_shape_and_space():
+    obs = _obs_at_step(_feature_df(), 5)
+    assert obs["features"].shape == (8, 3)
+    assert obs["features"].dtype == np.float32
+    assert "prices" in obs  # include_price_window default True
+
+
+def test_features_only_mode_drops_price_blocks():
+    obs = _obs_at_step(_feature_df(), 5, include_price_window=False)
+    assert "prices" not in obs and "returns" not in obs
+    assert "features" in obs and "position" in obs
+
+
+def test_future_poisoning_does_not_change_observation():
+    k = 20
+    clean = _obs_at_step(_feature_df(), k)
+    # poison every row STRICTLY AFTER the row the obs window ends on
+    # (obs at step k covers rows <= k; poison k+1 onward)
+    poisoned = _obs_at_step(_feature_df(poison_after=k + 1), k)
+    np.testing.assert_array_equal(clean["features"], poisoned["features"])
+    np.testing.assert_array_equal(clean["prices"], poisoned["prices"])
+
+
+def test_binary_columns_pass_through_unscaled():
+    df = _feature_df()
+    obs = _obs_at_step(df, 20)
+    # after k steps (the first is the same-bar warmup) bar_index = k,
+    # so the window covers rows [k-8, k) = 12..19
+    raw_b = df["b"].to_numpy()[12:20]
+    np.testing.assert_allclose(obs["features"][:, 2], raw_b, atol=1e-6)
+
+
+def test_scaled_values_match_reference_formula():
+    df = _feature_df()
+    k, w, sw = 25, 8, 16
+    obs = _obs_at_step(df, k)
+    vals = df[["f1", "f2"]].to_numpy(np.float64)
+    step = k  # after k steps bar_index = k; window covers rows [step-w, step)
+    hist = vals[step - sw:step]
+    mean, std = hist.mean(0), hist.std(0)
+    std = np.where(std < 1e-8, 1.0, std)
+    expect = (vals[step - w:step] - mean) / std
+    np.testing.assert_allclose(obs["features"][:, :2], expect, atol=2e-4)
+
+
+def test_warmup_neutral_zero_window():
+    df = _feature_df()
+    obs = _obs_at_step(df, 0)  # bar_index=1 -> 1 history row -> neutral
+    np.testing.assert_array_equal(obs["features"][:, :2], 0.0)
+    # binary passthrough applies even in the neutral window (reference
+    # _scale_window applies the mask after the zeros branch)
+    assert set(np.unique(obs["features"][:, 2])) <= {0.0, 1.0}
+
+
+def test_clip_bounds_features():
+    n = 60
+    rng = np.random.default_rng(1)
+    f = rng.normal(0, 1.0, n)
+    f[25] = 1e9  # spike inside the window at step 30 (rows 22..29)
+    df = make_df(1.1 + np.zeros(n), extra={"f1": f})
+    obs = _obs_at_step(
+        df, 30, feature_columns=["f1"], feature_binary_columns=[],
+        feature_clip=2.0,
+    )
+    # a lone spike z-scores to ~sqrt(window-1)=3.87 against its own
+    # rolling history, above the clip of 2.0
+    assert np.all(obs["features"] <= 2.0)
+    assert np.all(obs["features"] >= -2.0)
+    assert np.max(obs["features"]) == pytest.approx(2.0)
+
+
+def test_expanding_scaling_mode():
+    df = _feature_df()
+    k = 30
+    obs = _obs_at_step(df, k, feature_scaling="expanding_zscore")
+    vals = df[["f1", "f2"]].to_numpy(np.float64)
+    step = k
+    hist = vals[:step]
+    mean, std = hist.mean(0), hist.std(0)
+    std = np.where(std < 1e-8, 1.0, std)
+    expect = (vals[step - 8:step] - mean) / std
+    np.testing.assert_allclose(obs["features"][:, :2], expect, atol=2e-4)
+
+
+def test_missing_feature_column_rejected():
+    df = _feature_df()
+    with pytest.raises(ValueError, match="missing from dataframe"):
+        make_env(df, feature_columns=["nope"], window_size=8)
+
+
+def test_gym_space_includes_features_block():
+    from gymfx_tpu.gym_env import GymFxEnv
+    from gymfx_tpu.data.feed import MarketDataset
+    from gymfx_tpu.config import DEFAULT_VALUES
+
+    config = dict(DEFAULT_VALUES)
+    config.update(FEATURE_CFG)
+    config["timeframe"] = "M1"
+    df = _feature_df()
+    env = GymFxEnv(config, dataset=MarketDataset(df, config))
+    assert env.observation_space["features"].shape == (8, 3)
+    obs, info = env.reset()
+    assert env.observation_space.contains(obs)

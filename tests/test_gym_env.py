@@ -99,3 +99,21 @@ def test_build_environment_dispatcher():
     assert isinstance(env, GymFxEnv)
     with pytest.raises(ValueError, match="simulation_engine"):
         build_environment(config={**config, "simulation_engine": "magic"})
+
+
+def test_bracket_audit_trail(tmp_path, monkeypatch):
+    import json
+
+    audit = tmp_path / "audit.jsonl"
+    monkeypatch.setenv("GYMFX_BRACKET_AUDIT", str(audit))
+    env = _gym_env(strategy_plugin="direct_fixed_sltp", sl_pips=20.0,
+                   tp_pips=40.0, pip_size=0.0001)
+    obs, info = env.reset()
+    env.step(1)
+    env.step(0)
+    env.step(2)
+    records = [json.loads(l) for l in audit.read_text().splitlines()]
+    kinds = [r["kind"] for r in records]
+    assert "long_bracket" in kinds and "short_bracket" in kinds
+    long_rec = records[kinds.index("long_bracket")]
+    assert long_rec["stop"] < long_rec["entry"] < long_rec["limit"]
