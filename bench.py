@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Throughput benchmark — prints ONE JSON line.
+
+Workload: the north-star configuration (BASELINE.json) — PPO training
+of the 3-layer MLP policy on the EUR/USD 1-min example bars, rollout
+collection fused into the env scan, measured as env steps/sec on the
+local accelerator.  vs_baseline compares against the target of
+1M env steps/sec on a v5p-8 (8 cores) = 125k steps/sec/chip.
+
+Usage: python bench.py [--n_envs N] [--horizon T] [--iters K] [--quick]
+"""
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n_envs", type=int, default=4096)
+    ap.add_argument("--horizon", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--quick", action="store_true", help="small shapes (CI)")
+    args = ap.parse_args()
+    if args.quick:
+        args.n_envs, args.horizon, args.iters = 256, 32, 2
+
+    import jax
+
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        input_data_file="examples/data/eurusd_sample.csv",
+        num_envs=args.n_envs,
+        ppo_horizon=args.horizon,
+        ppo_epochs=1,
+        ppo_minibatches=4,
+        policy="mlp",
+        window_size=32,
+    )
+    env = Environment(config)
+    trainer = PPOTrainer(env, ppo_config_from(config))
+
+    state = trainer.init_state(0)
+    state, _ = trainer.train_step(state)  # compile + warmup
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        state, metrics = trainer.train_step(state)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    env_steps = args.n_envs * args.horizon * args.iters
+    steps_per_sec = env_steps / dt
+    baseline_per_chip = 1_000_000 / 8  # BASELINE.json: 1M steps/s on v5p-8
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_env_steps_per_sec_per_chip",
+                "value": round(steps_per_sec, 1),
+                "unit": "env steps/sec/chip (PPO MLP, fused rollout+update)",
+                "vs_baseline": round(steps_per_sec / baseline_per_chip, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

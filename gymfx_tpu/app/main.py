@@ -65,25 +65,23 @@ def make_cli_driver(config: Dict[str, Any]):
         return lambda obs, info, step: 0
     if mode == "buy_hold":
         return lambda obs, info, step: 1 if step == 0 else 0
-    if mode == "policy":
-        raise ValueError(
-            "driver_mode=policy requires a trained policy checkpoint "
-            "(run mode=training first and pass --checkpoint_dir)"
-        )
     raise ValueError(f"unknown driver_mode {mode!r}")
 
 
 def run_mode(config: Dict[str, Any]) -> Dict[str, Any]:
-    """Dispatch on mode.  ``training`` routes to the PPO trainer when the
-    train package is present; otherwise every mode runs the episode loop
-    (the reference validates the mode but runs the same loop for all
-    three — app/main.py:84)."""
+    """Dispatch: ``mode=training`` runs the PPO trainer;
+    ``driver_mode=policy`` restores a checkpoint and runs a greedy
+    evaluation episode; everything else runs the diagnostic episode
+    loop (the reference validates the mode but runs the same loop for
+    all three — app/main.py:84; training/policy are new capability)."""
     if config.get("mode") == "training":
-        try:
-            from gymfx_tpu.train.ppo import train_from_config
-        except ImportError:
-            return _run_env(config)
+        from gymfx_tpu.train.ppo import train_from_config
+
         return train_from_config(config)
+    if config.get("driver_mode") == "policy":
+        from gymfx_tpu.train.ppo import eval_policy_from_config
+
+        return eval_policy_from_config(config)
     return _run_env(config)
 
 

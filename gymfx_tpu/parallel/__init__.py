@@ -1,0 +1,5 @@
+from gymfx_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+)

@@ -1,0 +1,69 @@
+"""Device-mesh utilities: the distributed backbone.
+
+The reference has no distributed backend (no NCCL/MPI — SURVEY.md
+§2.9/§5.8); its only concurrency is one engine thread per env.  Here
+scale-out is native JAX SPMD: pick a mesh, annotate shardings, let XLA
+insert the collectives over ICI (psum for the learner all-reduce,
+all-gathers for tensor-sharded layers).  Multi-host extends the same
+mesh over DCN via ``jax.distributed.initialize`` (initialize_distributed).
+
+Axes:
+  data   env-batch data parallelism (rollout + gradient all-reduce)
+  model  tensor parallelism for wide policy layers
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh; default: all devices on the 'data' axis.
+
+    shape e.g. {"data": 4, "model": 2}; the product must divide the
+    device count (extra devices are left unused, deterministically).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not shape:
+        shape = {"data": len(devices)}
+    axis_names = tuple(shape.keys())
+    sizes = tuple(int(v) for v in shape.values())
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {need} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(sizes)
+    return Mesh(grid, axis_names)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Leading-dim sharding for env batches."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host (DCN) initialization; single-process no-op when no
+    coordinator is configured."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

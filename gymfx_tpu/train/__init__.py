@@ -1,0 +1,9 @@
+"""Training subsystem — new capability mandated by the north star.
+
+The reference is env-only ("env-only, agent-agnostic", reference
+app/cli.py:6); agents attach externally through reset/step.  Here the
+actor-learner is part of the framework: rollout collection is fused
+into the env scan on-device, and gradients all-reduce over the mesh
+(ICI) instead of leaving the chip.
+"""
+from gymfx_tpu.train import policies, ppo  # noqa: F401

@@ -1,0 +1,32 @@
+"""Checkpoint/resume via orbax — new capability (the reference has no
+training checkpointing; closest mechanisms are action replay and config
+save/restore, SURVEY.md §5.4)."""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional, Tuple
+
+import orbax.checkpoint as ocp
+
+
+def save_checkpoint(directory: str, params: Any, step: int = 0) -> str:
+    path = Path(directory).resolve()
+    path.mkdir(parents=True, exist_ok=True)
+    with ocp.CheckpointManager(path) as mngr:
+        mngr.save(int(step), args=ocp.args.StandardSave(params))
+        mngr.wait_until_finished()
+    return str(path)
+
+
+def load_checkpoint(directory: str, template: Optional[Any] = None) -> Tuple[Any, int]:
+    """Load the latest checkpoint; returns (params, step)."""
+    path = Path(directory).resolve()
+    with ocp.CheckpointManager(path) as mngr:
+        step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {path}")
+        if template is not None:
+            params = mngr.restore(step, args=ocp.args.StandardRestore(template))
+        else:
+            params = mngr.restore(step)
+    return params, int(step)

@@ -1,0 +1,156 @@
+"""Policy networks (flax.linen): MLP, LSTM, Transformer.
+
+Model families follow BASELINE.json's config ladder: 3-layer MLP
+(config 3), recurrent LSTM (config 4), Transformer (config 5).  All
+are actor-critic heads over the Dict observation; observations are
+flattened in a fixed key order so the same policies drive any obs
+layout (price windows, feature windows, stage-B/calendar blocks).
+
+TPU notes: matmul-heavy bodies sized for the MXU; parameters can be
+sharded over a 'model' mesh axis (see train/ppo.py shardings);
+compute dtype is configurable (bfloat16 on TPU, f32 reference path).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def flatten_obs(obs: Dict[str, Any]) -> Any:
+    """Dict obs -> flat feature vector (sorted key order, stable)."""
+    parts = [jnp.ravel(obs[k]).astype(jnp.float32) for k in sorted(obs.keys())]
+    return jnp.concatenate(parts, axis=0)
+
+
+def obs_size(obs: Dict[str, Any]) -> int:
+    return int(sum(int(jnp.size(v)) for v in obs.values()))
+
+
+class MLPPolicy(nn.Module):
+    """3-layer MLP actor-critic (BASELINE config 3)."""
+
+    n_actions: int = 3
+    hidden: Sequence[int] = (256, 256, 256)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for width in self.hidden:
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.tanh(x)
+        logits = nn.Dense(self.n_actions, dtype=jnp.float32)(x)
+        value = nn.Dense(1, dtype=jnp.float32)(x)
+        return logits, jnp.squeeze(value, axis=-1)
+
+    def initial_carry(self, batch_shape=()):
+        return ()
+
+    def apply_seq(self, params, x, carry):
+        logits, value = self.apply(params, x)
+        return logits, value, carry
+
+
+class LSTMPolicy(nn.Module):
+    """Recurrent actor-critic; the cell carry threads through the env
+    scan (BASELINE config 4)."""
+
+    n_actions: int = 3
+    hidden: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, carry):
+        x = x.astype(self.dtype)
+        x = nn.tanh(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        cell = nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype)
+        carry, x = cell(carry, x)
+        logits = nn.Dense(self.n_actions, dtype=jnp.float32)(x)
+        value = nn.Dense(1, dtype=jnp.float32)(x)
+        return logits, jnp.squeeze(value, axis=-1), carry
+
+    def initial_carry(self, batch_shape=()):
+        # (c, h) zeros — what LSTMCell.initialize_carry returns, built
+        # directly (flax modules cannot be instantiated outside a scope)
+        z = jnp.zeros((*batch_shape, self.hidden), dtype=self.dtype)
+        return (z, z)
+
+    def apply_seq(self, params, x, carry):
+        return self.apply(params, x, carry)
+
+
+class TransformerPolicy(nn.Module):
+    """Attention over the observation window (BASELINE config 5).
+
+    Expects the obs dict to contain at least one (window, k) block
+    ('features') or (window,) blocks ('prices'/'returns'); scalar
+    blocks are broadcast as extra tokens.  Attention heads and MLP
+    widths are chosen to tile the MXU (dims multiples of 128).
+    """
+
+    n_actions: int = 3
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        # tokens: (window, token_dim)
+        x = nn.Dense(self.d_model, dtype=self.dtype)(tokens.astype(self.dtype))
+        n = x.shape[-2]
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (n, self.d_model), jnp.float32
+        )
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.n_layers):
+            y = nn.LayerNorm(dtype=self.dtype)(x)
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.n_heads, dtype=self.dtype
+            )(y, y)
+            x = x + y
+            y = nn.LayerNorm(dtype=self.dtype)(x)
+            y = nn.Dense(self.d_model * 4, dtype=self.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(self.d_model, dtype=self.dtype)(y)
+            x = x + y
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        pooled = jnp.mean(x, axis=-2)
+        logits = nn.Dense(self.n_actions, dtype=jnp.float32)(pooled)
+        value = nn.Dense(1, dtype=jnp.float32)(pooled)
+        return logits, jnp.squeeze(value, axis=-1)
+
+    def initial_carry(self, batch_shape=()):
+        return ()
+
+    def apply_seq(self, params, tokens, carry):
+        logits, value = self.apply(params, tokens)
+        return logits, value, carry
+
+
+def tokens_from_obs(obs: Dict[str, Any], window: int) -> Any:
+    """Obs dict -> (window, token_dim) token sequence for the
+    TransformerPolicy: window-aligned blocks become per-bar token
+    features; scalar blocks broadcast along the window."""
+    cols = []
+    for k in sorted(obs.keys()):
+        v = obs[k]
+        if v.ndim >= 1 and v.shape[0] == window:
+            cols.append(v.reshape(window, -1).astype(jnp.float32))
+        else:
+            flat = jnp.ravel(v).astype(jnp.float32)
+            cols.append(jnp.broadcast_to(flat[None, :], (window, flat.shape[0])))
+    return jnp.concatenate(cols, axis=-1)
+
+
+def make_policy(name: str, n_actions: int = 3, dtype: Any = jnp.float32, **kw):
+    if name == "mlp":
+        return MLPPolicy(n_actions=n_actions, dtype=dtype, **kw)
+    if name == "lstm":
+        return LSTMPolicy(n_actions=n_actions, dtype=dtype, **kw)
+    if name == "transformer":
+        return TransformerPolicy(n_actions=n_actions, dtype=dtype, **kw)
+    raise ValueError(f"unknown policy {name!r}")

@@ -1,0 +1,459 @@
+"""PPO actor-learner, fused with the env scan, sharded over a mesh.
+
+New capability per the north star (BASELINE.json): the reference has no
+trainer.  Design:
+
+  * rollout collection IS the env scan: policy apply + env.step run in
+    one ``lax.scan`` per train step — no host round trips, no replay
+    buffers in host memory;
+  * the env batch is data-parallel across the mesh 'data' axis (each
+    device steps its shard of envs); wide policy layers may also be
+    tensor-sharded across 'model' (see shard_params);
+  * gradients are averaged over all envs — under jit with replicated
+    params and sharded batch, XLA emits the all-reduce over ICI;
+  * auto-reset: terminated envs restart from a fresh reset state inside
+    the scan, so training streams continuously over episodes.
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gymfx_tpu.core import env as env_core
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.train.policies import (
+    flatten_obs,
+    make_policy,
+    tokens_from_obs,
+)
+
+
+class PPOConfig(NamedTuple):
+    n_envs: int = 256
+    horizon: int = 128
+    epochs: int = 4
+    minibatches: int = 4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    lr: float = 3e-4
+    ent_coef: float = 0.01
+    vf_coef: float = 0.5
+    max_grad_norm: float = 0.5
+    policy: str = "mlp"
+    policy_dtype: Any = jnp.float32
+    policy_kwargs: Tuple[Tuple[str, Any], ...] = ()
+
+
+def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
+    dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        str(config.get("policy_dtype", "float32"))
+    ]
+    return PPOConfig(
+        n_envs=int(config.get("num_envs", 256) or 256),
+        horizon=int(config.get("ppo_horizon", 128)),
+        epochs=int(config.get("ppo_epochs", 4)),
+        minibatches=int(config.get("ppo_minibatches", 4)),
+        gamma=float(config.get("gamma", 0.99)),
+        gae_lambda=float(config.get("gae_lambda", 0.95)),
+        clip_eps=float(config.get("ppo_clip_eps", 0.2)),
+        lr=float(config.get("learning_rate", 3e-4)),
+        ent_coef=float(config.get("entropy_coef", 0.01)),
+        vf_coef=float(config.get("value_coef", 0.5)),
+        max_grad_norm=float(config.get("max_grad_norm", 0.5)),
+        policy=str(config.get("policy", "mlp")),
+        policy_dtype=dt,
+        policy_kwargs=tuple(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in (config.get("policy_kwargs") or {}).items()
+        ),
+    )
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    env_states: Any      # vmapped EnvState batch
+    obs_vec: Any         # (n_envs, obs_dim) policy inputs
+    policy_carry: Any    # recurrent carry (or ())
+    rng: Any
+
+
+class PPOTrainer:
+    """Builds the jitted train_step for (Environment, PPOConfig)."""
+
+    def __init__(self, env: Environment, pcfg: PPOConfig, mesh: Optional[Any] = None):
+        self.env = env
+        self.pcfg = pcfg
+        self.mesh = mesh
+        self.policy = make_policy(
+            pcfg.policy, dtype=pcfg.policy_dtype, **dict(pcfg.policy_kwargs)
+        )
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(pcfg.max_grad_norm),
+            optax.adam(pcfg.lr),
+        )
+
+        cfg, params, data = env.cfg, env.params, env.data
+        self._reset_state, reset_obs = env_core.reset(cfg, params, data)
+        self._is_transformer = pcfg.policy == "transformer"
+        self._window = cfg.window_size
+        self._reset_vec = self._encode(reset_obs)
+        self.obs_dim = self._reset_vec.shape
+
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+
+    # ------------------------------------------------------------------
+    def _encode(self, obs: Dict[str, Any]):
+        if self._is_transformer:
+            return tokens_from_obs(obs, self._window)
+        return flatten_obs(obs)
+
+    def init_state(self, seed: int = 0) -> TrainState:
+        rng = jax.random.PRNGKey(seed)
+        rng, k_init = jax.random.split(rng)
+        carry0 = self.policy.initial_carry(())
+        if self._is_transformer:
+            p = self.policy.init(k_init, self._reset_vec)
+        elif self.pcfg.policy == "lstm":
+            p = self.policy.init(k_init, self._reset_vec, carry0)
+        else:
+            p = self.policy.init(k_init, self._reset_vec)
+        opt_state = self.optimizer.init(p)
+
+        n = self.pcfg.n_envs
+        env_states = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n, *x.shape)), self._reset_state
+        )
+        obs_vec = jnp.broadcast_to(self._reset_vec, (n, *self._reset_vec.shape))
+        pcarry = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n, *x.shape)), carry0
+        )
+        state = TrainState(p, opt_state, env_states, obs_vec, pcarry, rng)
+        if self.mesh is not None:
+            state = self._shard_state(state)
+        return state
+
+    def _shard_state(self, state: TrainState) -> TrainState:
+        """Replicate params/opt, shard the env batch over the 'data' axis,
+        and tensor-shard wide policy matrices over 'model'."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        replicated = NamedSharding(mesh, P())
+        batch = NamedSharding(mesh, P("data"))
+
+        def shard_param(path, x):
+            if (
+                "model" in mesh.axis_names
+                and x.ndim == 2
+                and x.shape[-1] % mesh.shape["model"] == 0
+                and x.shape[-1] >= 128
+            ):
+                return jax.device_put(x, NamedSharding(mesh, P(None, "model")))
+            return jax.device_put(x, replicated)
+
+        params = jax.tree_util.tree_map_with_path(shard_param, state.params)
+        opt_state = jax.tree.map(
+            lambda x: jax.device_put(x, replicated)
+            if hasattr(x, "shape")
+            else x,
+            state.opt_state,
+        )
+        env_states = jax.tree.map(lambda x: jax.device_put(x, batch), state.env_states)
+        obs_vec = jax.device_put(state.obs_vec, batch)
+        pcarry = jax.tree.map(lambda x: jax.device_put(x, batch), state.policy_carry)
+        rng = jax.device_put(state.rng, replicated)
+        return TrainState(params, opt_state, env_states, obs_vec, pcarry, rng)
+
+    # ------------------------------------------------------------------
+    def _policy_forward(self, params, obs_vec, pcarry):
+        if self.pcfg.policy == "lstm":
+            return self.policy.apply(params, obs_vec, pcarry)
+        logits, value = self.policy.apply(params, obs_vec)
+        return logits, value, pcarry
+
+    def _rollout(self, params, env_states, obs_vec, pcarry, rng):
+        cfg, eparams, data = self.env.cfg, self.env.params, self.env.data
+        vstep = jax.vmap(env_core.step, in_axes=(None, None, None, 0, 0))
+        vencode = jax.vmap(self._encode)
+        fwd = jax.vmap(self._policy_forward, in_axes=(None, 0, 0))
+        reset_state = self._reset_state
+        reset_vec = self._reset_vec
+        carry0 = self.policy.initial_carry(())
+
+        def body(carry, _):
+            env_states, obs_vec, pcarry, rng = carry
+            rng, k = jax.random.split(rng)
+            logits, value, pcarry2 = fwd(params, obs_vec, pcarry)
+            keys = jax.random.split(k, logits.shape[0])
+            action = jax.vmap(jax.random.categorical)(keys, logits)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), action[:, None], axis=1
+            )[:, 0]
+            env_states2, obs2, reward, done, _ = vstep(
+                cfg, eparams, data, env_states, action
+            )
+            obs_vec2 = vencode(obs2)
+            # auto-reset terminated envs (fresh episode, fresh carry)
+            env_states2 = jax.tree.map(
+                lambda fresh, cur: jnp.where(
+                    done.reshape(done.shape + (1,) * (cur.ndim - 1)), fresh, cur
+                ),
+                jax.tree.map(
+                    lambda x: jnp.broadcast_to(x, (done.shape[0], *x.shape)),
+                    reset_state,
+                ),
+                env_states2,
+            )
+            obs_vec2 = jnp.where(
+                done.reshape(done.shape + (1,) * (obs_vec2.ndim - 1)),
+                reset_vec,
+                obs_vec2,
+            )
+            pcarry2 = jax.tree.map(
+                lambda fresh, cur: jnp.where(
+                    done.reshape(done.shape + (1,) * (cur.ndim - 1)),
+                    jnp.broadcast_to(fresh, cur.shape),
+                    cur,
+                ),
+                carry0,
+                pcarry2,
+            )
+            out = dict(
+                obs=obs_vec, action=action, logp=logp, value=value,
+                reward=reward.astype(jnp.float32), done=done,
+            )
+            return (env_states2, obs_vec2, pcarry2, rng), out
+
+        (env_states, obs_vec, pcarry, rng), traj = jax.lax.scan(
+            body, (env_states, obs_vec, pcarry, rng), None,
+            length=self.pcfg.horizon,
+        )
+        # bootstrap value for the final obs
+        logits, last_value, _ = fwd(params, obs_vec, pcarry)
+        return env_states, obs_vec, pcarry, rng, traj, last_value
+
+    def _gae(self, traj, last_value):
+        g, lam = self.pcfg.gamma, self.pcfg.gae_lambda
+
+        def body(carry, x):
+            adv_next, v_next = carry
+            reward, value, done = x
+            nonterm = 1.0 - done.astype(jnp.float32)
+            delta = reward + g * v_next * nonterm - value
+            adv = delta + g * lam * nonterm * adv_next
+            return (adv, value), adv
+
+        (_, _), advs = jax.lax.scan(
+            body,
+            (jnp.zeros_like(last_value), last_value),
+            (traj["reward"], traj["value"], traj["done"]),
+            reverse=True,
+        )
+        returns = advs + traj["value"]
+        return advs, returns
+
+    def _loss(self, params, batch):
+        logits, value, _ = jax.vmap(
+            self._policy_forward, in_axes=(None, 0, 0)
+        )(params, batch["obs"], batch["pcarry"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, batch["action"][:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["adv"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1 - self.pcfg.clip_eps, 1 + self.pcfg.clip_eps) * adv
+        policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+        value_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (
+            policy_loss
+            + self.pcfg.vf_coef * value_loss
+            - self.pcfg.ent_coef * entropy
+        )
+        return total, dict(
+            policy_loss=policy_loss, value_loss=value_loss, entropy=entropy
+        )
+
+    def _train_step_impl(self, state: TrainState):
+        pcfg = self.pcfg
+        env_states, obs_vec, pcarry_end, rng, traj, last_value = self._rollout(
+            state.params, state.env_states, state.obs_vec, state.policy_carry,
+            state.rng,
+        )
+        advs, returns = self._gae(traj, last_value)
+
+        # flatten (T, N, ...) -> (T*N, ...)
+        n_total = pcfg.horizon * pcfg.n_envs
+        flat = {
+            "obs": traj["obs"].reshape(n_total, *traj["obs"].shape[2:]),
+            "action": traj["action"].reshape(n_total),
+            "logp": traj["logp"].reshape(n_total),
+            "adv": advs.reshape(n_total),
+            "ret": returns.reshape(n_total),
+        }
+        # Recurrent PPO simplification: minibatches see a zero carry (the
+        # stored rollout logp was computed with the live carry).  Standard
+        # for short-horizon PPO-LSTM variants; IMPALA handles long
+        # recurrence properly (train/impala.py).
+        carry0 = self.policy.initial_carry(())
+        flat["pcarry"] = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_total, *x.shape)), carry0
+        )
+
+        params, opt_state = state.params, state.opt_state
+        mb = n_total // pcfg.minibatches
+
+        def epoch_body(carry, k):
+            params, opt_state = carry
+            perm = jax.random.permutation(k, n_total)
+
+            def mb_body(carry, i):
+                params, opt_state = carry
+                idx = jax.lax.dynamic_slice_in_dim(perm, i * mb, mb)
+                batch = jax.tree.map(lambda x: x[idx], flat)
+                (loss, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+                    params, batch
+                )
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, aux)
+
+            (params, opt_state), (losses, auxes) = jax.lax.scan(
+                mb_body, (params, opt_state), jnp.arange(pcfg.minibatches)
+            )
+            return (params, opt_state), (losses, auxes)
+
+        rng, *ks = jax.random.split(rng, pcfg.epochs + 1)
+        (params, opt_state), (losses, auxes) = jax.lax.scan(
+            epoch_body, (params, opt_state), jnp.stack(ks)
+        )
+
+        metrics = dict(
+            loss=losses.mean(),
+            policy_loss=auxes["policy_loss"].mean(),
+            value_loss=auxes["value_loss"].mean(),
+            entropy=auxes["entropy"].mean(),
+            mean_reward=traj["reward"].mean(),
+            mean_episode_done=traj["done"].mean(),
+        )
+        new_state = TrainState(
+            params, opt_state, env_states, obs_vec, pcarry_end, rng
+        )
+        return new_state, metrics
+
+    # ------------------------------------------------------------------
+    def train_step(self, state: TrainState):
+        return self._train_step(state)
+
+    def train(self, total_env_steps: int, seed: int = 0, log_every: int = 10):
+        state = self.init_state(seed)
+        steps_per_iter = self.pcfg.n_envs * self.pcfg.horizon
+        iters = max(1, int(total_env_steps) // steps_per_iter)
+        t0 = time.perf_counter()
+        metrics = {}
+        for it in range(iters):
+            state, metrics = self.train_step(state)
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+        metrics = {k: float(v) for k, v in metrics.items()}
+        metrics["env_steps_per_sec"] = steps_per_iter * iters / dt
+        metrics["iterations"] = iters
+        metrics["total_env_steps"] = steps_per_iter * iters
+        return state, metrics
+
+
+# ---------------------------------------------------------------------------
+def greedy_policy_driver(trainer: PPOTrainer, params):
+    """Deterministic (argmax) eval driver for core.rollout."""
+    from gymfx_tpu.core.rollout import Driver
+
+    carry0 = trainer.policy.initial_carry(())
+
+    def act(carry, obs, i, key):
+        vec = trainer._encode(obs)
+        logits, _value, carry = trainer._policy_forward(params, vec, carry)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), carry
+
+    return Driver(init=lambda: carry0, act=act)
+
+
+def evaluate(trainer: PPOTrainer, params, steps: Optional[int] = None, seed: int = 0):
+    """Greedy-policy episode -> reference-style metrics summary."""
+    from gymfx_tpu.core.rollout import rollout
+    from gymfx_tpu.metrics import compute_analyzers, summarize_trading
+
+    env = trainer.env
+    steps = int(steps or env.cfg.n_bars - 1)
+    driver = greedy_policy_driver(trainer, params)
+    state, out = rollout(
+        env.cfg, env.params, env.data, driver, steps, jax.random.PRNGKey(seed)
+    )
+    equity = np.asarray(out["equity_delta"], np.float64) + float(
+        env.params.initial_cash
+    )
+    done = np.asarray(out["done"])
+    ts = env.dataset.timestamps.iloc[1 : steps + 1]
+    analyzers = compute_analyzers(equity=equity, done=done, state=state, timestamps=ts)
+    final_eq = float(equity[int(np.argmax(done))] if done.any() else equity[-1])
+    summary = summarize_trading(
+        initial_cash=float(env.params.initial_cash),
+        final_equity=final_eq,
+        analyzers=analyzers,
+        config=env.config,
+    )
+    summary["sharpe_ratio_steps"] = _step_sharpe(equity)
+    return summary
+
+
+def _step_sharpe(equity: np.ndarray) -> Optional[float]:
+    rets = np.diff(equity) / equity[:-1]
+    if rets.size < 2 or rets.std(ddof=1) == 0:
+        return None
+    return float(rets.mean() / rets.std(ddof=1) * np.sqrt(252 * 24 * 60))
+
+
+def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """CLI driver_mode=policy: load the checkpointed policy and run a
+    greedy evaluation episode."""
+    ckpt_dir = config.get("checkpoint_dir")
+    if not ckpt_dir:
+        raise ValueError("driver_mode=policy requires checkpoint_dir")
+    from gymfx_tpu.train.checkpoint import load_checkpoint
+
+    env = Environment(config)
+    trainer = PPOTrainer(env, ppo_config_from(config))
+    template = trainer.init_state(0).params
+    params, step = load_checkpoint(str(ckpt_dir), template=template)
+    summary = evaluate(trainer, params, steps=config.get("steps"))
+    summary["checkpoint_step"] = step
+    return summary
+
+
+def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """CLI mode=training entry: train PPO, optionally checkpoint,
+    return a summary merging training metrics and greedy-eval metrics."""
+    env = Environment(config)
+    pcfg = ppo_config_from(config)
+    trainer = PPOTrainer(env, pcfg)
+    total = int(config.get("train_total_steps", 1_000_000))
+    state, train_metrics = trainer.train(total, seed=int(config.get("seed", 0) or 0))
+
+    summary = evaluate(trainer, state.params)
+    summary["train_metrics"] = train_metrics
+
+    ckpt_dir = config.get("checkpoint_dir")
+    if ckpt_dir:
+        from gymfx_tpu.train.checkpoint import save_checkpoint
+
+        save_checkpoint(ckpt_dir, state.params, step=train_metrics["total_env_steps"])
+        summary["checkpoint_dir"] = str(ckpt_dir)
+    return summary

@@ -1,0 +1,69 @@
+"""Mesh sharding: batched rollouts and the PPO train step over the
+virtual 8-device CPU mesh (multi-chip validation without hardware —
+SURVEY.md §4 note on simulated meshes)."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+from gymfx_tpu.parallel import batch_sharding, make_mesh, replicated_sharding
+from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+from tests.helpers import uptrend_df
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8  # conftest forces 8 CPU devices
+    mesh2 = make_mesh({"data": 4, "model": 2})
+    assert mesh2.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh({"data": 16})
+
+
+def test_sharded_vmapped_rollout_matches_unsharded():
+    from gymfx_tpu.core.rollout import random_driver, rollout
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1")
+    df = uptrend_df(80)
+    env = Environment(config, dataset=MarketDataset(df, config))
+    mesh = make_mesh({"data": 8})
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 16)
+
+    def run(key):
+        _, out = rollout(env.cfg, env.params, env.data, random_driver(), 40, key)
+        return out["equity_delta"], out["action"]
+
+    # unsharded reference
+    eq_ref, act_ref = jax.vmap(run)(keys)
+    # sharded over the mesh: same computation, batch split across devices
+    keys_sharded = jax.device_put(keys, batch_sharding(mesh))
+    eq_sh, act_sh = jax.jit(jax.vmap(run))(keys_sharded)
+    np.testing.assert_array_equal(np.asarray(act_ref), np.asarray(act_sh))
+    np.testing.assert_allclose(np.asarray(eq_ref), np.asarray(eq_sh), atol=1e-6)
+
+
+def test_ppo_train_step_on_mesh():
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=16, ppo_horizon=8,
+                  ppo_epochs=1, ppo_minibatches=2,
+                  policy_kwargs={"hidden": [128, 128]})
+    df = uptrend_df(60)
+    env = Environment(config, dataset=MarketDataset(df, config))
+    mesh = make_mesh({"data": 4, "model": 2})
+    trainer = PPOTrainer(env, ppo_config_from(config), mesh=mesh)
+    state = trainer.init_state(0)
+    # env batch sharded over 'data'
+    shard_names = {
+        s.spec for s in [state.obs_vec.sharding]
+    }
+    assert P("data") in shard_names
+    state, metrics = trainer.train_step(state)
+    assert np.isfinite(float(metrics["loss"]))
+    # a second step reuses the compiled program
+    state, metrics = trainer.train_step(state)
+    assert np.isfinite(float(metrics["loss"]))
