@@ -106,14 +106,21 @@ def rollout(
     steps: int,
     rng: Any,
     collect: bool = True,
+    driver_carry: Any = None,
 ):
     """Run one episode for ``steps`` env steps (frozen after termination).
 
     Returns (final_state, outputs) where outputs is a dict of per-step
     arrays (equity, reward, done, action, position) when ``collect``,
     else an empty dict — training collects its own trajectories.
+
+    ``driver`` is a STATIC argument (jit cache key by identity); runtime
+    data a driver needs (e.g. policy weights) must flow through
+    ``driver_carry``, which is traced — that way re-evaluating with new
+    weights reuses the compiled episode instead of retracing it.
     """
     state, obs = env_core.reset(cfg, params, data)
+    init_carry = driver.init() if driver_carry is None else driver_carry
 
     def body(carry, i):
         state, obs, rng, dcarry = carry
@@ -139,7 +146,7 @@ def rollout(
         return (state, obs, rng, dcarry), out
 
     (state, obs, rng, _), outputs = jax.lax.scan(
-        body, (state, obs, rng, driver.init()), jnp.arange(steps)
+        body, (state, obs, rng, init_carry), jnp.arange(steps)
     )
     return state, outputs
 

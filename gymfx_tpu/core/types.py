@@ -281,6 +281,28 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig) -> EnvParams:
         return f(disabled if x is None else x)
 
     slippage = config.get("slippage_perc", config.get("slippage", 0.0)) or 0.0
+    commission = config.get("commission", 0.0)
+    # An execution cost profile (path or dict) overrides commission and
+    # fill displacement: fills move adversely from mid by
+    # half-spread + slippage (contracts.py quote_adverse_rate_per_side).
+    # The reference applies profiles only on its Nautilus engine
+    # (simulation_engines/nautilus_gym.py:236-238); the scan engine
+    # honors them directly.
+    profile_raw = config.get("execution_cost_profile")
+    if profile_raw:
+        from gymfx_tpu.contracts import (
+            ExecutionCostProfile,
+            load_execution_cost_profile,
+        )
+
+        if isinstance(profile_raw, str):
+            profile = load_execution_cost_profile(profile_raw)
+        elif isinstance(profile_raw, dict):
+            profile = ExecutionCostProfile.from_dict(profile_raw)
+        else:
+            profile = profile_raw
+        commission = profile.commission_rate_per_side
+        slippage = profile.quote_adverse_rate_per_side
     entry_start_mow = (
         int(config.get("entry_dow_start", 0)) * 24 * 60
         + int(config.get("entry_hour_start", 12)) * 60
@@ -292,7 +314,7 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig) -> EnvParams:
     return EnvParams(
         initial_cash=f(initial_cash),
         position_size=f(config.get("position_size", 1.0)),
-        commission=f(config.get("commission", 0.0)),
+        commission=f(commission),
         slippage=f(slippage),
         leverage=f(config.get("leverage", 1.0)),
         min_equity=f(min_equity),

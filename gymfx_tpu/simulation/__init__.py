@@ -1,0 +1,3 @@
+from gymfx_tpu.simulation.replay import ReplayAdapter, stable_hash  # noqa: F401
+from gymfx_tpu.simulation import fixtures  # noqa: F401
+from gymfx_tpu.simulation.oracle import reconcile_fills  # noqa: F401

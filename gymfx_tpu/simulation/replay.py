@@ -1,0 +1,403 @@
+"""Deterministic multi-asset target-position replay engine.
+
+Counterpart of the reference's NautilusTrader adapter (reference
+simulation_engines/nautilus_adapter.py:315-458): run a scripted list of
+target-position actions through an execution engine under a versioned
+ExecutionCostProfile and export immutable event facts with sha256
+event/result hashes.
+
+Engineering stance: the THROUGHPUT engine of this framework is the XLA
+scan kernel (core/broker.py); this replay engine is the
+verification-grade twin — an explicit float64 event machine that walks
+quote paths tick by tick.  It exists to prove execution semantics
+(netting, partial close, reversal, intrabar bracket ordering, margin
+preflight with cross-currency conversion, overnight financing) with
+bit-stable, content-hashable outputs, exactly the role the external
+Nautilus engine plays for the reference.
+
+Execution model:
+  * each MarketFrame expands to quote ticks along its execution_path
+    (default: just the close), bid/ask displaced from mid by the
+    profile's quote_adverse_rate_per_side (contracts.py:44-47);
+  * a target action at a frame's timestamp nets against the current
+    position; market orders fill at the current top-of-book (ask for
+    buys, bid for sells) of that frame's LAST path tick;
+  * brackets (SL/TP on a flat->open action) are evaluated against every
+    subsequent quote tick in path order, so intrabar collision ordering
+    is defined by the data's execution_path, not by a heuristic;
+  * margin preflight: opening units require margin_init * notional
+    (standard model) or margin_init * notional / leverage (leveraged
+    model), converted to the account currency at the current mid;
+    insufficient free balance -> preflight_denied, no order;
+  * financing (when enabled): positions held across the 22:00 UTC
+    rollover accrue interest from the annualized short-rate differential
+    of the pair (rate table rows LOCATION/TIME/Value, one row per
+    currency area per month — reference fixture schema
+    examples/data/fx_rollover_rates_smoke.csv).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import asdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from gymfx_tpu.contracts import (
+    ExecutionCostProfile,
+    InstrumentSpec,
+    MarketFrame,
+    TargetAction,
+)
+
+ENGINE_NAME = "gymfx_tpu.scan_replay"
+ENGINE_VERSION = "1.0.0"
+
+ROLLOVER_UTC_SECONDS = 22 * 3600  # 17:00 New York standard time
+_CURRENCY_LOCATION = {"EUR": "EA19", "USD": "USA", "JPY": "JPN", "GBP": "GBR"}
+
+
+def stable_hash(value: Any) -> str:
+    payload = json.dumps(value, sort_keys=True, separators=(",", ":"), default=str)
+    return "sha256:" + hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+def _fmt(x: float, precision: int = 10) -> str:
+    """Canonical decimal formatting so hashes are platform-stable."""
+    return f"{x:.{precision}f}".rstrip("0").rstrip(".") or "0"
+
+
+class _Position:
+    __slots__ = ("units", "avg_price")
+
+    def __init__(self) -> None:
+        self.units = 0.0
+        self.avg_price = 0.0
+
+
+class ReplayAdapter:
+    """Run deterministic target-position scripts through the replay engine."""
+
+    def __init__(self, profile: ExecutionCostProfile) -> None:
+        self.profile = profile
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        *,
+        instrument_specs: List[InstrumentSpec],
+        frames: List[MarketFrame],
+        actions: List[TargetAction],
+        initial_cash: float = 100_000.0,
+        base_currency: str = "USD",
+        default_leverage: float = 20.0,
+        financing_rate_data: Any = None,
+    ) -> Dict[str, Any]:
+        profile = self.profile
+        if profile.financing_enabled and financing_rate_data is None:
+            raise ValueError(
+                "financing_rate_data is required when financing_enabled is true"
+            )
+        venues = {spec.venue for spec in instrument_specs}
+        if len(venues) != 1:
+            raise ValueError(
+                "one replay currently requires a single shared-account venue"
+            )
+
+        specs = {spec.instrument_id: spec for spec in instrument_specs}
+        adverse = profile.quote_adverse_rate_per_side
+        events: List[Dict[str, Any]] = []
+        positions: Dict[str, _Position] = {k: _Position() for k in specs}
+        brackets: Dict[str, Dict[str, float]] = {}
+        active_action: Dict[str, str] = {}
+        balance = float(initial_cash)
+        order_seq = 0
+        order_count = 0
+        rates = _parse_rate_table(financing_rate_data)
+
+        # Timeline: all frames sorted by timestamp; ticks expanded per frame.
+        frames_sorted = sorted(frames, key=lambda f: (f.ts_event_ns, f.instrument_id))
+        action_by_key = {(a.instrument_id, a.ts_event_ns): a for a in actions}
+
+        def mid_of(instrument_id: str, default: float) -> float:
+            return last_mid.get(instrument_id, default)
+
+        last_mid: Dict[str, float] = {}
+        last_rollover_day: Optional[int] = None
+
+        def conversion(spec: InstrumentSpec, mid: float) -> float:
+            """quote currency -> account currency at current mid."""
+            if spec.quote_currency == base_currency:
+                return 1.0
+            if spec.base_currency == base_currency:
+                return 1.0 / mid
+            raise ValueError(
+                f"cannot convert {spec.quote_currency} to {base_currency} "
+                f"using {spec.instrument_id}"
+            )
+
+        def emit(event: Dict[str, Any]) -> None:
+            events.append(event)
+
+        def fill(
+            instrument_id: str,
+            side: str,
+            qty: float,
+            price: float,
+            mid: float,
+            ts: int,
+            order_id: str,
+            action_id: str,
+        ) -> None:
+            nonlocal balance
+            spec = specs[instrument_id]
+            pos = positions[instrument_id]
+            conv = conversion(spec, mid)
+            signed = qty if side == "BUY" else -qty
+
+            if pos.units == 0 or pos.units * signed > 0:
+                new_units = pos.units + signed
+                if pos.units == 0:
+                    pos.avg_price = price
+                else:
+                    pos.avg_price = (
+                        abs(pos.units) * pos.avg_price + abs(signed) * price
+                    ) / abs(new_units)
+                pos.units = new_units
+            else:
+                closing = min(abs(pos.units), abs(signed))
+                quote_pnl = (
+                    closing * (price - pos.avg_price)
+                    if pos.units > 0
+                    else closing * (pos.avg_price - price)
+                )
+                balance += quote_pnl * conv
+                new_units = pos.units + signed
+                if pos.units * new_units < 0:
+                    pos.avg_price = price
+                elif new_units == 0:
+                    pos.avg_price = 0.0
+                pos.units = new_units
+
+            commission = float(profile.commission_rate_per_side) * qty * price
+            balance -= commission * conv
+            emit(
+                {
+                    "event_type": "order_filled",
+                    "ts_event_ns": int(ts),
+                    "instrument_id": instrument_id,
+                    "action_id": action_id,
+                    "client_order_id": order_id,
+                    "side": side,
+                    "quantity": _fmt(qty),
+                    "price": _fmt(price),
+                    "commission": _fmt(commission),
+                    "commission_currency": spec.quote_currency,
+                    "position_units_after": _fmt(pos.units),
+                    "reference_mid": _fmt(mid),
+                }
+            )
+            if pos.units == 0:
+                active_action.pop(instrument_id, None)
+
+        def check_brackets(instrument_id: str, bid: float, ask: float, mid: float, ts: int) -> None:
+            nonlocal order_seq, order_count
+            br = brackets.get(instrument_id)
+            pos = positions[instrument_id]
+            if not br or pos.units == 0:
+                return
+            long = pos.units > 0
+            exit_qty = abs(pos.units)
+            sl, tp = br["sl"], br["tp"]
+            if long:
+                sl_hit = bid <= sl
+                tp_hit = bid >= tp
+            else:
+                sl_hit = ask >= sl
+                tp_hit = ask <= tp
+            if not (sl_hit or tp_hit):
+                return
+            # path order decides: this tick triggered one (or both — SL
+            # priority within a single tick, the conservative read)
+            exit_price = sl if sl_hit else tp
+            order_seq += 1
+            order_count += 1
+            fill(
+                instrument_id,
+                "SELL" if long else "BUY",
+                exit_qty,
+                exit_price,
+                mid,
+                ts,
+                f"O-{order_seq}",
+                active_action.get(instrument_id, "bracket-exit"),
+            )
+            brackets.pop(instrument_id, None)
+
+        def apply_rollover(ts: int) -> None:
+            nonlocal balance, last_rollover_day
+            if not profile.financing_enabled:
+                return
+            day = int(ts // 86_400_000_000_000)
+            second_of_day = int(ts // 1_000_000_000) % 86_400
+            if second_of_day < ROLLOVER_UTC_SECONDS:
+                return
+            if last_rollover_day == day:
+                return
+            last_rollover_day = day
+            for instrument_id, pos in positions.items():
+                if pos.units == 0:
+                    continue
+                spec = specs[instrument_id]
+                mid = mid_of(instrument_id, pos.avg_price)
+                base_rate = rates.get(spec.base_currency, 0.0)
+                quote_rate = rates.get(spec.quote_currency, 0.0)
+                # long base earns base rate, pays quote rate (annualized %)
+                differential = (base_rate - quote_rate) / 100.0 / 365.0
+                interest_quote = pos.units * mid * differential
+                conv = conversion(spec, mid)
+                amount = interest_quote * conv
+                balance += amount
+                emit(
+                    {
+                        "event_type": "financing_applied",
+                        "ts_event_ns": int(ts),
+                        "instrument_id": instrument_id,
+                        "position_units": _fmt(pos.units),
+                        "rate_differential_annual_pct": _fmt(base_rate - quote_rate),
+                        "amount": _fmt(amount),
+                        "currency": base_currency,
+                    }
+                )
+
+        for frame in frames_sorted:
+            spec = specs[frame.instrument_id]
+            path: Tuple[float, ...] = tuple(frame.execution_path or (frame.close,))
+            # walk intrabar ticks: brackets can exit mid-path
+            for mid in path:
+                bid = mid * (1.0 - adverse)
+                ask = mid * (1.0 + adverse)
+                last_mid[frame.instrument_id] = mid
+                check_brackets(frame.instrument_id, bid, ask, mid, frame.ts_event_ns)
+            apply_rollover(frame.ts_event_ns)
+
+            action = action_by_key.get((frame.instrument_id, frame.ts_event_ns))
+            if action is None:
+                continue
+            pos = positions[frame.instrument_id]
+            current = pos.units
+            delta = float(action.target_units) - current
+            emit(
+                {
+                    "event_type": "target_requested",
+                    "ts_event_ns": int(frame.ts_event_ns),
+                    "instrument_id": frame.instrument_id,
+                    "action_id": action.action_id,
+                    "target_units": _fmt(float(action.target_units)),
+                    "current_units": _fmt(current),
+                    "delta_units": _fmt(delta),
+                }
+            )
+            active_action[frame.instrument_id] = action.action_id
+            if delta == 0:
+                continue
+
+            mid = last_mid[frame.instrument_id]
+            side = "BUY" if delta > 0 else "SELL"
+            fill_price = mid * (1.0 + adverse) if delta > 0 else mid * (1.0 - adverse)
+
+            if profile.enforce_margin_preflight:
+                opening = 0.0
+                if current == 0 or current * delta > 0:
+                    opening = abs(delta)
+                elif abs(delta) > abs(current):
+                    opening = abs(delta) - abs(current)
+                if opening > 0:
+                    notional_quote = opening * mid
+                    required_quote = notional_quote * float(spec.margin_init)
+                    if self.profile.margin_model == "leveraged":
+                        required_quote /= max(float(default_leverage), 1e-12)
+                    required = required_quote * conversion(spec, mid)
+                    if required > balance:
+                        emit(
+                            {
+                                "event_type": "preflight_denied",
+                                "ts_event_ns": int(frame.ts_event_ns),
+                                "instrument_id": frame.instrument_id,
+                                "action_id": action.action_id,
+                                "reason": "CUM_MARGIN_EXCEEDS_FREE_BALANCE",
+                                "required_margin_in_free_currency": _fmt(required),
+                                "free_balance": _fmt(balance),
+                            }
+                        )
+                        continue
+
+            order_seq += 1
+            order_count += 1
+            order_id = f"O-{order_seq}"
+            fill(
+                frame.instrument_id,
+                side,
+                abs(delta),
+                fill_price,
+                mid,
+                frame.ts_event_ns,
+                order_id,
+                action.action_id,
+            )
+            if (
+                current == 0
+                and action.stop_loss_price is not None
+                and action.take_profit_price is not None
+            ):
+                brackets[frame.instrument_id] = {
+                    "sl": float(action.stop_loss_price),
+                    "tp": float(action.take_profit_price),
+                }
+
+        open_positions = sum(1 for p in positions.values() if p.units != 0)
+        event_facts = [
+            {"sequence": sequence, **event} for sequence, event in enumerate(events)
+        ]
+        summary = {
+            "final_balance": _fmt(balance),
+            "currency": base_currency,
+            "positions_open": open_positions,
+            "total_orders": order_count,
+        }
+        deterministic_payload = {
+            "engine": ENGINE_NAME,
+            "engine_version": ENGINE_VERSION,
+            "profile": asdict(self.profile),
+            "events": event_facts,
+            "summary": summary,
+        }
+        return {
+            **deterministic_payload,
+            "event_hash": stable_hash(event_facts),
+            "result_hash": stable_hash(deterministic_payload),
+            "native": {
+                "iterations": len(frames_sorted),
+                "total_events": len(event_facts),
+                "total_orders": order_count,
+                "total_positions": len(
+                    {e["instrument_id"] for e in event_facts if e["event_type"] == "order_filled"}
+                ),
+            },
+        }
+
+
+def _parse_rate_table(rate_data: Any) -> Dict[str, float]:
+    """LOCATION/TIME/Value rows -> currency -> latest annual rate (%)."""
+    if rate_data is None:
+        return {}
+    location_to_ccy = {v: k for k, v in _CURRENCY_LOCATION.items()}
+    rates: Dict[str, float] = {}
+    try:
+        rows = rate_data.to_dict("records")  # pandas DataFrame
+    except AttributeError:
+        rows = list(rate_data)
+    for row in rows:
+        ccy = location_to_ccy.get(str(row.get("LOCATION")))
+        if ccy:
+            rates[ccy] = float(row.get("Value", 0.0))
+    return rates

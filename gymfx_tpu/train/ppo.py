@@ -301,9 +301,10 @@ class PPOTrainer:
             "ret": returns.reshape(n_total),
         }
         # Recurrent PPO simplification: minibatches see a zero carry (the
-        # stored rollout logp was computed with the live carry).  Standard
-        # for short-horizon PPO-LSTM variants; IMPALA handles long
-        # recurrence properly (train/impala.py).
+        # stored rollout logp was computed with the live carry) — the
+        # standard shortcut in short-horizon PPO-LSTM variants.  Proper
+        # long-recurrence credit assignment belongs to an off-policy
+        # IMPALA-style learner with stored carries.
         carry0 = self.policy.initial_carry(())
         flat["pcarry"] = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (n_total, *x.shape)), carry0
@@ -354,7 +355,9 @@ class PPOTrainer:
     def train_step(self, state: TrainState):
         return self._train_step(state)
 
-    def train(self, total_env_steps: int, seed: int = 0, log_every: int = 10):
+    def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0):
+        """Run PPO for ~total_env_steps; log metrics every ``log_every``
+        iterations when > 0."""
         state = self.init_state(seed)
         steps_per_iter = self.pcfg.n_envs * self.pcfg.horizon
         iters = max(1, int(total_env_steps) // steps_per_iter)
@@ -362,6 +365,9 @@ class PPOTrainer:
         metrics = {}
         for it in range(iters):
             state, metrics = self.train_step(state)
+            if log_every and (it + 1) % log_every == 0:
+                snap = {k: float(v) for k, v in metrics.items()}
+                print(f"[ppo] iter {it + 1}/{iters} {snap}")
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
         metrics = {k: float(v) for k, v in metrics.items()}
@@ -372,18 +378,23 @@ class PPOTrainer:
 
 
 # ---------------------------------------------------------------------------
-def greedy_policy_driver(trainer: PPOTrainer, params):
-    """Deterministic (argmax) eval driver for core.rollout."""
+def greedy_policy_driver(trainer: PPOTrainer):
+    """Deterministic (argmax) eval driver.  Cached per trainer: the
+    Driver is a static jit argument, so the policy params travel in the
+    (traced) driver carry — repeated evals with new weights reuse the
+    compiled episode scan."""
+    if getattr(trainer, "_greedy_driver", None) is not None:
+        return trainer._greedy_driver
     from gymfx_tpu.core.rollout import Driver
 
-    carry0 = trainer.policy.initial_carry(())
-
     def act(carry, obs, i, key):
+        params, pcarry = carry
         vec = trainer._encode(obs)
-        logits, _value, carry = trainer._policy_forward(params, vec, carry)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), carry
+        logits, _value, pcarry = trainer._policy_forward(params, vec, pcarry)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), (params, pcarry)
 
-    return Driver(init=lambda: carry0, act=act)
+    trainer._greedy_driver = Driver(init=lambda: (), act=act)
+    return trainer._greedy_driver
 
 
 def evaluate(trainer: PPOTrainer, params, steps: Optional[int] = None, seed: int = 0):
@@ -393,9 +404,10 @@ def evaluate(trainer: PPOTrainer, params, steps: Optional[int] = None, seed: int
 
     env = trainer.env
     steps = int(steps or env.cfg.n_bars - 1)
-    driver = greedy_policy_driver(trainer, params)
+    driver = greedy_policy_driver(trainer)
     state, out = rollout(
-        env.cfg, env.params, env.data, driver, steps, jax.random.PRNGKey(seed)
+        env.cfg, env.params, env.data, driver, steps, jax.random.PRNGKey(seed),
+        driver_carry=(params, trainer.policy.initial_carry(())),
     )
     equity = np.asarray(out["equity_delta"], np.float64) + float(
         env.params.initial_cash
@@ -410,15 +422,19 @@ def evaluate(trainer: PPOTrainer, params, steps: Optional[int] = None, seed: int
         analyzers=analyzers,
         config=env.config,
     )
-    summary["sharpe_ratio_steps"] = _step_sharpe(equity)
+    tf_hours = env.dataset.timeframe_hours or (1.0 / 60.0)
+    summary["sharpe_ratio_steps"] = _step_sharpe(equity, tf_hours)
     return summary
 
 
-def _step_sharpe(equity: np.ndarray) -> Optional[float]:
+def _step_sharpe(equity: np.ndarray, timeframe_hours: float) -> Optional[float]:
+    """Per-step Sharpe annualized by the bar timeframe (252 trading
+    days x 24h / bar hours steps per year)."""
     rets = np.diff(equity) / equity[:-1]
     if rets.size < 2 or rets.std(ddof=1) == 0:
         return None
-    return float(rets.mean() / rets.std(ddof=1) * np.sqrt(252 * 24 * 60))
+    steps_per_year = 252.0 * 24.0 / max(timeframe_hours, 1e-9)
+    return float(rets.mean() / rets.std(ddof=1) * np.sqrt(steps_per_year))
 
 
 def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:

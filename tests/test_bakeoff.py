@@ -1,0 +1,164 @@
+"""Replay-engine bake-off: determinism hashes, oracle reconciliation,
+intrabar collision ordering, margin rejection, financing, causal-prefix
+invariance and cross-process determinism
+(reference tests/test_nautilus_bakeoff.py patterns + tools/nautilus_parallel_smoke.py)."""
+import dataclasses
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from gymfx_tpu.simulation import ReplayAdapter, fixtures, reconcile_fills
+
+INITIAL = 100_000.0
+
+
+def _run(fixture_fn=fixtures.build_multi_asset_fixture, profile=None, **kw):
+    profile = profile or fixtures.default_profile()
+    instruments, frames, actions = fixture_fn()
+    adapter = ReplayAdapter(profile)
+    result = adapter.run(
+        instrument_specs=instruments,
+        frames=frames,
+        actions=actions,
+        initial_cash=INITIAL,
+        **kw,
+    )
+    return instruments, profile, result
+
+
+def test_multi_asset_replay_is_deterministic():
+    _, _, r1 = _run()
+    _, _, r2 = _run()
+    assert r1["result_hash"] == r2["result_hash"]
+    assert r1["event_hash"] == r2["event_hash"]
+    assert r1["native"]["total_orders"] == 6
+    assert r1["summary"]["positions_open"] == 0
+
+
+def test_oracle_reconciliation_within_tolerance():
+    instruments, profile, result = _run()
+    oracle = reconcile_fills(
+        result, instruments, profile, initial_cash=INITIAL
+    )
+    native_final = float(result["summary"]["final_balance"])
+    assert oracle["all_positions_flat"]
+    assert oracle["fill_count"] == 6
+    assert abs(native_final - oracle["expected_final_balance"]) <= 0.02
+
+
+def test_partial_close_and_reversal_net_correctly():
+    _, _, result = _run()
+    fills = [e for e in result["events"] if e["event_type"] == "order_filled"]
+    eur = [f for f in fills if f["instrument_id"] == "EUR/USD.SIM"]
+    after = [float(f["position_units_after"]) for f in eur]
+    assert after == [3000.0, 1000.0, -2000.0, 0.0]
+
+
+def test_intrabar_collision_path_order_sl_first():
+    instruments, profile, result = _run(fixtures.build_intrabar_collision_fixture)
+    fills = [e for e in result["events"] if e["event_type"] == "order_filled"]
+    assert len(fills) == 2  # entry + stop exit, TP never fills
+    exit_fill = fills[-1]
+    assert exit_fill["side"] == "SELL"
+    assert float(exit_fill["price"]) == pytest.approx(1.08200, abs=1e-9)
+    # losing trade: final balance below initial
+    assert float(result["summary"]["final_balance"]) < INITIAL
+    oracle = reconcile_fills(result, instruments, profile, initial_cash=INITIAL)
+    assert abs(
+        float(result["summary"]["final_balance"]) - oracle["expected_final_balance"]
+    ) <= 0.02
+
+
+def test_margin_rejection_denies_oversized_order():
+    _, _, result = _run(fixtures.build_margin_rejection_fixture)
+    events = result["events"]
+    denied = [e for e in events if e["event_type"] == "preflight_denied"]
+    fills = [e for e in events if e["event_type"] == "order_filled"]
+    assert len(denied) == 1
+    assert denied[0]["reason"] == "CUM_MARGIN_EXCEEDS_FREE_BALANCE"
+    assert fills == []
+    assert float(result["summary"]["final_balance"]) == INITIAL
+
+
+def test_financing_accrues_over_rollover():
+    profile = fixtures.default_profile(financing_enabled=True)
+    instruments, frames, actions = fixtures.build_financing_fixture()
+    adapter = ReplayAdapter(profile)
+    result = adapter.run(
+        instrument_specs=instruments,
+        frames=frames,
+        actions=actions,
+        initial_cash=INITIAL,
+        financing_rate_data=fixtures.build_rollover_rate_fixture(),
+    )
+    fin = [e for e in result["events"] if e["event_type"] == "financing_applied"]
+    assert len(fin) == 1
+    # EUR long vs USD: rate differential 4.5 - 5.25 < 0 -> pays interest
+    assert float(fin[0]["amount"]) < 0
+    oracle = reconcile_fills(result, instruments, profile, initial_cash=INITIAL)
+    assert abs(
+        float(result["summary"]["final_balance"]) - oracle["expected_final_balance"]
+    ) <= 0.02
+
+
+def test_financing_requires_rate_data():
+    profile = fixtures.default_profile(financing_enabled=True)
+    instruments, frames, actions = fixtures.build_financing_fixture()
+    with pytest.raises(ValueError, match="financing_rate_data"):
+        ReplayAdapter(profile).run(
+            instrument_specs=instruments, frames=frames, actions=actions
+        )
+
+
+def test_causal_prefix_invariance_under_last_bar_mutation():
+    """Mutating the final bar must not change any event before it
+    (reference tests/test_nautilus_bakeoff.py:124-156)."""
+    instruments, frames, actions = fixtures.build_multi_asset_fixture()
+    profile = fixtures.default_profile()
+    cutoff = max(f.ts_event_ns for f in frames)
+    base = ReplayAdapter(profile).run(
+        instrument_specs=instruments, frames=frames, actions=actions,
+        initial_cash=INITIAL,
+    )
+    base_prefix = [e for e in base["events"] if e["ts_event_ns"] < cutoff]
+    for bump in (0.0005, -0.0008, 0.0011, -0.0003, 0.0021):
+        mutated = [
+            dataclasses.replace(
+                f,
+                open=f.open + bump,
+                high=f.high + bump,
+                low=f.low + bump,
+                close=f.close + bump,
+            )
+            if f.ts_event_ns == cutoff
+            else f
+            for f in frames
+        ]
+        res = ReplayAdapter(profile).run(
+            instrument_specs=instruments, frames=mutated, actions=actions,
+            initial_cash=INITIAL,
+        )
+        prefix = [e for e in res["events"] if e["ts_event_ns"] < cutoff]
+        assert prefix == base_prefix
+
+
+def _worker_hash(_):
+    from gymfx_tpu.simulation import ReplayAdapter, fixtures
+
+    instruments, frames, actions = fixtures.build_multi_asset_fixture()
+    result = ReplayAdapter(fixtures.default_profile()).run(
+        instrument_specs=instruments, frames=frames, actions=actions,
+        initial_cash=100_000.0,
+    )
+    return result["result_hash"]
+
+
+def test_cross_process_determinism():
+    """Spawned processes produce identical result hashes
+    (reference tools/nautilus_parallel_smoke.py:32-51)."""
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(2) as pool:
+        hashes = pool.map(_worker_hash, range(2))
+    assert len(set(hashes)) == 1
+    assert hashes[0] == _worker_hash(0)

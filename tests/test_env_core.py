@@ -179,3 +179,30 @@ def test_vmap_batched_envs():
     assert eq.shape == (8, 30)
     # different seeds took different paths
     assert len({float(x) for x in eq[:, -1]}) > 1
+
+
+def test_execution_cost_profile_drives_fill_pricing():
+    # profile overrides commission and displaces fills adversely by
+    # half-spread + slippage
+    profile = {
+        "schema_version": "execution_cost_profile.v1",
+        "profile_id": "t",
+        "commission_rate_per_side": 0.0001,
+        "full_spread_rate": 0.0002,
+        "slippage_bps_per_side": 1.0,   # 1e-4
+        "latency_ms": 0,
+        "financing_enabled": False,
+        "intrabar_collision_policy": "worst_case",
+        "limit_fill_policy": "conservative",
+        "margin_model": "standard",
+        "enforce_margin_preflight": False,
+        "random_seed": 0,
+    }
+    env = make_env(uptrend_df(), execution_cost_profile=profile)
+    adverse = 0.0002 / 2 + 1.0 / 10_000
+    assert float(env.params.slippage) == pytest.approx(adverse)
+    assert float(env.params.commission) == pytest.approx(0.0001)
+    state, out = env.rollout(R.buy_hold_driver(), steps=5)
+    opens = np.asarray(env.data.open)
+    fill = opens[1] * (1 + adverse)
+    assert float(state.commission_paid) == pytest.approx(0.0001 * fill, rel=1e-5)

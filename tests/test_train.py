@@ -98,3 +98,20 @@ def test_evaluate_produces_metrics_summary():
     summary = evaluate(tr, s.params, steps=60)
     for key in ("total_return", "sharpe_ratio", "max_drawdown_pct", "rap"):
         assert key in summary
+
+
+def test_repeated_evaluate_reuses_compiled_episode():
+    # evaluate with different params must not retrace the episode scan:
+    # params travel through the traced driver carry.
+    tr = _trainer()
+    s = tr.init_state(0)
+    s1 = evaluate(tr, s.params, steps=40)
+    s, _ = tr.train_step(s)
+    import jax
+    from gymfx_tpu.core import rollout as rollout_mod
+
+    before = rollout_mod.rollout._cache_size()
+    s2 = evaluate(tr, s.params, steps=40)
+    after = rollout_mod.rollout._cache_size()
+    assert after == before  # second eval hit the jit cache
+    assert "total_return" in s2

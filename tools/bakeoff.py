@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Replay-engine bake-off runner (reference tools/nautilus_bakeoff.py:27-74):
+run the multi-asset fixture >=2 times, assert identical result hashes,
+reconcile against the independent fill oracle, emit evidence JSON.
+Exits non-zero on non-determinism or oracle divergence.
+"""
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    from gymfx_tpu.simulation import ReplayAdapter, fixtures, reconcile_fills
+
+    profile = fixtures.default_profile()
+    instruments, frames, actions = fixtures.build_multi_asset_fixture()
+    initial = 100_000.0
+
+    results = [
+        ReplayAdapter(profile).run(
+            instrument_specs=instruments,
+            frames=frames,
+            actions=actions,
+            initial_cash=initial,
+        )
+        for _ in range(3)
+    ]
+    hashes = {r["result_hash"] for r in results}
+    if len(hashes) != 1:
+        print(f"NON-DETERMINISTIC: {hashes}")
+        return 1
+
+    result = results[0]
+    oracle = reconcile_fills(result, instruments, profile, initial_cash=initial)
+    native_final = float(result["summary"]["final_balance"])
+    divergence = abs(native_final - oracle["expected_final_balance"])
+    evidence = {
+        "schema": "simulation_engine_bakeoff.v1",
+        "engine": result["engine"],
+        "engine_version": result["engine_version"],
+        "runs": len(results),
+        "result_hash": result["result_hash"],
+        "event_hash": result["event_hash"],
+        "orders": result["native"]["total_orders"],
+        "positions_open": result["summary"]["positions_open"],
+        "native_final_balance": native_final,
+        "oracle_expected_final_balance": oracle["expected_final_balance"],
+        "divergence": divergence,
+        "oracle": oracle,
+    }
+    out = REPO / "examples" / "results" / "bakeoff_evidence.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(evidence, indent=2, default=str))
+    print(json.dumps({k: evidence[k] for k in (
+        "schema", "runs", "result_hash", "divergence")}, indent=2))
+    if divergence > 0.02:
+        print(f"ORACLE DIVERGENCE {divergence} > 0.02")
+        return 1
+    if result["summary"]["positions_open"] != 0:
+        print("positions not flat at end")
+        return 1
+    print("bakeoff passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+#!/usr/bin/env python3
+"""Gymnasium API compliance check on a default-plugin env
+(reference tools/check_gym_compliance.py:49-56)."""
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    from gymnasium.utils.env_checker import check_env
+
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.gym_env import build_environment
+
+    config = dict(DEFAULT_VALUES)
+    config["input_data_file"] = str(
+        REPO / "examples" / "data" / "eurusd_sample.csv"
+    )
+    env = build_environment(config=config)
+    check_env(env, skip_render_check=True)
+    print("gymnasium check_env passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Cross-process determinism smoke (reference tools/nautilus_parallel_smoke.py:32-51):
+a spawn-based pool (>=2 workers) runs the same replay; all result
+hashes must be identical."""
+import multiprocessing as mp
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def worker(_):
+    sys.path.insert(0, str(REPO))
+    from gymfx_tpu.simulation import ReplayAdapter, fixtures
+
+    instruments, frames, actions = fixtures.build_multi_asset_fixture()
+    result = ReplayAdapter(fixtures.default_profile()).run(
+        instrument_specs=instruments,
+        frames=frames,
+        actions=actions,
+        initial_cash=100_000.0,
+    )
+    return result["result_hash"]
+
+
+def main() -> int:
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(2) as pool:
+        hashes = pool.map(worker, range(4))
+    if len(set(hashes)) != 1:
+        print(f"cross-process hashes diverged: {set(hashes)}")
+        return 1
+    print(f"parallel smoke passed: 4 runs over 2 processes, hash {hashes[0][:24]}...")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Engine overhead benchmark (reference tools/simulation_engine_benchmark.py:84-128):
+time fresh-run overhead of (a) the XLA scan engine on a full episode
+and (b) the replay verification engine on the bake-off fixture, >=3
+runs each; emit schema-versioned evidence JSON with mean/median/min/max
+seconds, runs/sec, and max RSS.  Like the reference, this measures
+FRESH-RUN overhead, not normalized per-event throughput (bench.py is
+the throughput benchmark).
+"""
+import json
+import pathlib
+import resource
+import statistics
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _timed(fn, runs):
+    samples = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    return {
+        "runs": runs,
+        "mean_seconds": statistics.mean(samples),
+        "median_seconds": statistics.median(samples),
+        "min_seconds": min(samples),
+        "max_seconds": max(samples),
+        "runs_per_second": runs / sum(samples),
+    }
+
+
+def main() -> int:
+    runs = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+
+    # --- scan engine: fresh episode, jit-cached after the first -------
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core import rollout as R
+    from gymfx_tpu.core.runtime import Environment
+
+    config = dict(DEFAULT_VALUES)
+    config["input_data_file"] = str(REPO / "examples" / "data" / "eurusd_sample.csv")
+    env = Environment(config)
+
+    def scan_episode():
+        state, out = env.rollout(R.buy_hold_driver(), steps=400)
+        out["equity_delta"].block_until_ready()
+
+    scan_episode()  # compile once; overhead benchmark measures warm runs
+
+    # --- replay engine: fresh bake-off fixture run --------------------
+    from gymfx_tpu.simulation import ReplayAdapter, fixtures
+
+    profile = fixtures.default_profile()
+    instruments, frames, actions = fixtures.build_multi_asset_fixture()
+
+    def replay_run():
+        ReplayAdapter(profile).run(
+            instrument_specs=instruments,
+            frames=frames,
+            actions=actions,
+            initial_cash=100_000.0,
+        )
+
+    evidence = {
+        "schema": "simulation_engine_benchmark.v1",
+        "note": "fresh-run overhead, not normalized per-event throughput",
+        "engines": {
+            "scan(400-step episode)": _timed(scan_episode, runs),
+            "replay(bakeoff fixture)": _timed(replay_run, runs),
+        },
+        "max_rss_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+    }
+    out = REPO / "examples" / "results" / "engine_benchmark.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(evidence, indent=2))
+    print(json.dumps(evidence, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

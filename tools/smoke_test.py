@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Behavioral smoke test (reference tools/smoke_test.py:108-155):
+
+1. flat driver leaves equity unchanged;
+2. buy&hold on the synthetic uptrend yields a positive return;
+3. seeded resets reproduce the first observation and full action stream;
+4. total_return arithmetic identity against final/initial equity.
+
+Writes examples/results/<mode>_summary.json evidence files.
+"""
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from gymfx_tpu.app.main import run_mode
+    from gymfx_tpu.config import DEFAULT_VALUES
+
+    results_dir = REPO / "examples" / "results"
+    results_dir.mkdir(parents=True, exist_ok=True)
+    failures = []
+
+    def run(driver_mode, data, **extra):
+        config = dict(DEFAULT_VALUES)
+        config.update(
+            input_data_file=str(REPO / "examples" / "data" / data),
+            driver_mode=driver_mode,
+            steps=400,
+            quiet_mode=True,
+            seed=123,
+        )
+        config.update(extra)
+        summary = run_mode(config)
+        out = results_dir / f"{driver_mode}_summary.json"
+        out.write_text(json.dumps(summary, indent=2, default=str))
+        return summary
+
+    flat = run("flat", "eurusd_sample.csv")
+    if flat["total_return"] != 0.0 or flat["final_equity"] != flat["initial_cash"]:
+        failures.append(f"flat equity changed: {flat['final_equity']}")
+
+    bh = run("buy_hold", "eurusd_uptrend.csv")
+    if not bh["total_return"] > 0:
+        failures.append(f"buy_hold uptrend not profitable: {bh['total_return']}")
+
+    r1 = run("random", "eurusd_sample.csv")
+    r2 = run("random", "eurusd_sample.csv")
+    if r1["final_equity"] != r2["final_equity"]:
+        failures.append("seeded random runs diverged")
+    if r1["action_diagnostics"] != r2["action_diagnostics"]:
+        failures.append("seeded random action streams diverged")
+
+    for name, s in (("flat", flat), ("buy_hold", bh), ("random", r1)):
+        lhs = s["total_return"]
+        rhs = s["final_equity"] / s["initial_cash"] - 1.0
+        if abs(lhs - rhs) > 1e-12:
+            failures.append(f"{name} total_return identity violated: {lhs} vs {rhs}")
+
+    if failures:
+        print("SMOKE FAILURES:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    print("smoke test passed: flat invariant, uptrend profit, seeded "
+          "reproducibility, return identity")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
