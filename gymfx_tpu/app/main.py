@@ -75,9 +75,17 @@ def run_mode(config: Dict[str, Any]) -> Dict[str, Any]:
     loop (the reference validates the mode but runs the same loop for
     all three — app/main.py:84; training/policy are new capability)."""
     if config.get("mode") == "training":
+        if str(config.get("trainer", "ppo")).lower() == "impala":
+            from gymfx_tpu.train.impala import train_impala_from_config
+
+            return train_impala_from_config(config)
         from gymfx_tpu.train.ppo import train_from_config
 
         return train_from_config(config)
+    if config.get("mode") == "optimization":
+        from gymfx_tpu.train.optimize import optimize_from_config
+
+        return optimize_from_config(config)
     if config.get("driver_mode") == "policy":
         from gymfx_tpu.train.ppo import eval_policy_from_config
 

@@ -64,5 +64,7 @@ DEFAULT_VALUES = {
     "mesh_shape": None,       # e.g. {"data": 4, "model": 2}; None = single device
     "train_total_steps": 1_000_000,
     "checkpoint_dir": None,
-    "policy": "mlp",          # mlp|lstm|transformer
+    # policy: unset by default — PPO defaults to "mlp", IMPALA to "lstm";
+    # pass --policy mlp|lstm|transformer to override.
+    "policy": None,
 }

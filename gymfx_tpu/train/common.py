@@ -1,0 +1,24 @@
+"""Shared training helpers."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_reset(done, fresh_tree, cur_tree):
+    """Where ``done`` (batch bool), replace each leaf of ``cur_tree``
+    with the (broadcast) corresponding leaf of ``fresh_tree``.  Used for
+    env-state / obs / recurrent-carry auto-reset inside rollout scans —
+    one definition so actor rollout and learner replay cannot diverge.
+    """
+
+    def expand(d, leaf):
+        return d.reshape(d.shape + (1,) * (leaf.ndim - 1))
+
+    return jax.tree.map(
+        lambda fresh, cur: jnp.where(
+            expand(done, cur), jnp.broadcast_to(fresh, cur.shape), cur
+        ),
+        fresh_tree,
+        cur_tree,
+    )

@@ -1,0 +1,340 @@
+"""IMPALA actor-learner with V-trace off-policy correction.
+
+New capability (BASELINE.json config 4: recurrent LSTM policy, IMPALA
+async actor-learner over ICI).  Single-program SPMD formulation: the
+"actors" are the vmapped env batch stepping with a STALE copy of the
+policy (synced every ``sync_every`` learner updates — that staleness is
+exactly what V-trace corrects), the learner consumes whole trajectory
+segments.  On a pod the same program shards actors over the mesh 'data'
+axis and the gradient all-reduce rides ICI; across hosts the mesh
+extends over DCN — no parameter server, no gRPC queues.
+
+Unlike the PPO-LSTM shortcut (ppo.py), the learner REPLAYS the segment
+through the policy with the stored initial carry, so recurrent credit
+assignment is exact over the segment.
+
+V-trace (Espeholt et al. 2018):
+  delta_t = rho_t (r_t + gamma_t V(x_{t+1}) - V(x_t))
+  vs_t    = V(x_t) + delta_t + gamma_t c_t (vs_{t+1} - V(x_{t+1}))
+  pg_adv  = rho_t (r_t + gamma_t vs_{t+1} - V(x_t))
+with rho_t = min(rho_bar, pi/mu), c_t = min(c_bar, pi/mu).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gymfx_tpu.core import env as env_core
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.train.common import masked_reset
+from gymfx_tpu.train.policies import flatten_obs, make_policy, tokens_from_obs
+
+
+class ImpalaConfig(NamedTuple):
+    n_envs: int = 256
+    unroll: int = 64
+    gamma: float = 0.99
+    rho_bar: float = 1.0
+    c_bar: float = 1.0
+    lr: float = 3e-4
+    ent_coef: float = 0.01
+    vf_coef: float = 0.5
+    max_grad_norm: float = 0.5
+    sync_every: int = 4          # actor params refresh period (staleness)
+    policy: str = "lstm"
+    policy_dtype: Any = jnp.float32
+    policy_kwargs: Tuple[Tuple[str, Any], ...] = ()
+
+
+def impala_config_from(config: Dict[str, Any]) -> ImpalaConfig:
+    dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        str(config.get("policy_dtype", "float32"))
+    ]
+    return ImpalaConfig(
+        n_envs=int(config.get("num_envs", 256) or 256),
+        unroll=int(config.get("impala_unroll", 64)),
+        gamma=float(config.get("gamma", 0.99)),
+        rho_bar=float(config.get("vtrace_rho_bar", 1.0)),
+        c_bar=float(config.get("vtrace_c_bar", 1.0)),
+        lr=float(config.get("learning_rate", 3e-4)),
+        ent_coef=float(config.get("entropy_coef", 0.01)),
+        vf_coef=float(config.get("value_coef", 0.5)),
+        max_grad_norm=float(config.get("max_grad_norm", 0.5)),
+        sync_every=int(config.get("impala_sync_every", 4)),
+        policy=str(config.get("policy") or "lstm"),
+        policy_dtype=dt,
+        policy_kwargs=tuple(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in (config.get("policy_kwargs") or {}).items()
+        ),
+    )
+
+
+class ImpalaState(NamedTuple):
+    learner_params: Any
+    actor_params: Any
+    opt_state: Any
+    env_states: Any
+    obs_vec: Any
+    policy_carry: Any
+    rng: Any
+    updates_since_sync: Any  # i32
+
+
+class ImpalaTrainer:
+    def __init__(self, env: Environment, icfg: ImpalaConfig, mesh: Optional[Any] = None):
+        self.env = env
+        self.icfg = icfg
+        self.mesh = mesh
+        self.policy = make_policy(
+            icfg.policy, dtype=icfg.policy_dtype, **dict(icfg.policy_kwargs)
+        )
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(icfg.max_grad_norm),
+            optax.adam(icfg.lr),
+        )
+        cfg, params, data = env.cfg, env.params, env.data
+        self._reset_state, reset_obs = env_core.reset(cfg, params, data)
+        self._is_transformer = icfg.policy == "transformer"
+        self._window = cfg.window_size
+        self._reset_vec = self._encode(reset_obs)
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+
+    def _encode(self, obs):
+        if self._is_transformer:
+            return tokens_from_obs(obs, self._window)
+        return flatten_obs(obs)
+
+    def _forward(self, params, x, carry):
+        if self.icfg.policy == "lstm":
+            return self.policy.apply(params, x, carry)
+        logits, value = self.policy.apply(params, x)
+        return logits, value, carry
+
+    # ------------------------------------------------------------------
+    def init_state(self, seed: int = 0) -> ImpalaState:
+        rng = jax.random.PRNGKey(seed)
+        rng, k = jax.random.split(rng)
+        carry0 = self.policy.initial_carry(())
+        if self.icfg.policy == "lstm":
+            p = self.policy.init(k, self._reset_vec, carry0)
+        else:
+            p = self.policy.init(k, self._reset_vec)
+        n = self.icfg.n_envs
+        bcast = lambda x: jnp.broadcast_to(x, (n, *x.shape))  # noqa: E731
+        return ImpalaState(
+            learner_params=p,
+            # distinct buffers: learner and actor trees are both donated
+            # by the jitted step, and XLA rejects donating one buffer twice
+            actor_params=jax.tree.map(jnp.copy, p),
+            opt_state=self.optimizer.init(p),
+            env_states=jax.tree.map(bcast, self._reset_state),
+            obs_vec=bcast(self._reset_vec),
+            policy_carry=jax.tree.map(bcast, carry0),
+            rng=rng,
+            updates_since_sync=jnp.zeros((), jnp.int32),
+        )
+
+    # ------------------------------------------------------------------
+    def _rollout(self, actor_params, env_states, obs_vec, pcarry, rng):
+        cfg, eparams, data = self.env.cfg, self.env.params, self.env.data
+        vstep = jax.vmap(env_core.step, in_axes=(None, None, None, 0, 0))
+        vencode = jax.vmap(self._encode)
+        fwd = jax.vmap(self._forward, in_axes=(None, 0, 0))
+        carry0 = self.policy.initial_carry(())
+        reset_state, reset_vec = self._reset_state, self._reset_vec
+
+        def body(carry, _):
+            env_states, obs_vec, pcarry, rng = carry
+            rng, k = jax.random.split(rng)
+            logits, _value, pcarry2 = fwd(actor_params, obs_vec, pcarry)
+            keys = jax.random.split(k, logits.shape[0])
+            action = jax.vmap(jax.random.categorical)(keys, logits)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), action[:, None], axis=1
+            )[:, 0]
+            env_states2, obs2, reward, done, _ = vstep(
+                cfg, eparams, data, env_states, action
+            )
+            obs_vec2 = vencode(obs2)
+            env_states2 = masked_reset(done, reset_state, env_states2)
+            obs_vec2 = masked_reset(done, reset_vec, obs_vec2)
+            pcarry2 = masked_reset(done, carry0, pcarry2)
+            out = dict(
+                obs=obs_vec, action=action, mu_logp=logp,
+                reward=reward.astype(jnp.float32), done=done,
+            )
+            return (env_states2, obs_vec2, pcarry2, rng), out
+
+        (env_states, obs_vec, pcarry, rng), traj = jax.lax.scan(
+            body, (env_states, obs_vec, pcarry, rng), None, length=self.icfg.unroll
+        )
+        return env_states, obs_vec, pcarry, rng, traj
+
+    def _learner_replay(self, params, traj, init_carry, final_obs_vec):
+        """Recompute logits/values over the segment with the LEARNER
+        params, threading the true recurrent carry (reset on done)."""
+        fwd = jax.vmap(self._forward, in_axes=(None, 0, 0))
+        carry0 = self.policy.initial_carry(())
+
+        def body(pcarry, x):
+            obs, done = x
+            logits, value, pcarry2 = fwd(params, obs, pcarry)
+            pcarry2 = masked_reset(done, carry0, pcarry2)
+            return pcarry2, (logits, value)
+
+        pcarry, (logits, values) = jax.lax.scan(
+            body, init_carry, (traj["obs"], traj["done"])
+        )
+        _, bootstrap, _ = fwd(params, final_obs_vec, pcarry)
+        return logits, values, bootstrap
+
+    def _vtrace(self, values, bootstrap, rewards, dones, rhos):
+        g = self.icfg.gamma
+        discounts = g * (1.0 - dones.astype(jnp.float32))
+        cs = jnp.minimum(self.icfg.c_bar, rhos)
+        clipped_rhos = jnp.minimum(self.icfg.rho_bar, rhos)
+        values_next = jnp.concatenate([values[1:], bootstrap[None]], axis=0)
+        deltas = clipped_rhos * (rewards + discounts * values_next - values)
+
+        def body(acc, x):
+            delta, discount, c = x
+            acc = delta + discount * c * acc
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            body,
+            jnp.zeros_like(bootstrap),
+            (deltas, discounts, cs),
+            reverse=True,
+        )
+        vs = values + vs_minus_v
+        vs_next = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
+        pg_adv = clipped_rhos * (rewards + discounts * vs_next - values)
+        return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+    def _loss(self, params, traj, init_carry, final_obs_vec):
+        logits, values, bootstrap = self._learner_replay(
+            params, traj, init_carry, final_obs_vec
+        )
+        logp_all = jax.nn.log_softmax(logits)
+        pi_logp = jnp.take_along_axis(
+            logp_all, traj["action"][..., None], axis=-1
+        )[..., 0]
+        rhos = jnp.exp(pi_logp - traj["mu_logp"])
+        vs, pg_adv = self._vtrace(
+            values, bootstrap, traj["reward"], traj["done"], rhos
+        )
+        policy_loss = -jnp.mean(pi_logp * pg_adv)
+        value_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (
+            policy_loss
+            + self.icfg.vf_coef * value_loss
+            - self.icfg.ent_coef * entropy
+        )
+        return total, dict(
+            policy_loss=policy_loss,
+            value_loss=value_loss,
+            entropy=entropy,
+            mean_rho=rhos.mean(),
+        )
+
+    def _train_step_impl(self, state: ImpalaState):
+        env_states, obs_vec, pcarry, rng, traj = self._rollout(
+            state.actor_params, state.env_states, state.obs_vec,
+            state.policy_carry, state.rng,
+        )
+        (loss, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
+            state.learner_params, traj, state.policy_carry, obs_vec
+        )
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.learner_params
+        )
+        learner_params = optax.apply_updates(state.learner_params, updates)
+
+        count = state.updates_since_sync + 1
+        do_sync = count >= self.icfg.sync_every
+        actor_params = jax.tree.map(
+            lambda new, old: jnp.where(do_sync, new, old),
+            learner_params,
+            state.actor_params,
+        )
+        count = jnp.where(do_sync, 0, count)
+
+        metrics = dict(
+            loss=loss,
+            mean_reward=traj["reward"].mean(),
+            mean_episode_done=traj["done"].mean(),
+            **aux,
+        )
+        return (
+            ImpalaState(
+                learner_params, actor_params, opt_state, env_states,
+                obs_vec, pcarry, rng, count,
+            ),
+            metrics,
+        )
+
+    # ------------------------------------------------------------------
+    def train_step(self, state: ImpalaState):
+        return self._train_step(state)
+
+    def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0):
+        state = self.init_state(seed)
+        per_iter = self.icfg.n_envs * self.icfg.unroll
+        iters = max(1, int(total_env_steps) // per_iter)
+        t0 = time.perf_counter()
+        metrics: Dict[str, Any] = {}
+        for it in range(iters):
+            state, metrics = self.train_step(state)
+            if log_every and (it + 1) % log_every == 0:
+                print(f"[impala] iter {it + 1}/{iters} "
+                      f"{ {k: float(v) for k, v in metrics.items()} }")
+        jax.block_until_ready(state.learner_params)
+        dt = time.perf_counter() - t0
+        out = {k: float(v) for k, v in metrics.items()}
+        out["env_steps_per_sec"] = per_iter * iters / dt
+        out["iterations"] = iters
+        out["total_env_steps"] = per_iter * iters
+        return state, out
+
+
+def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    env = Environment(config)
+    icfg = impala_config_from(config)
+    trainer = ImpalaTrainer(env, icfg)
+    total = int(config.get("train_total_steps", 1_000_000))
+    state, train_metrics = trainer.train(total, seed=int(config.get("seed", 0) or 0))
+
+    # greedy eval through the shared evaluate() machinery
+    from gymfx_tpu.train import ppo as ppo_mod
+
+    eval_shim = _EvalShim(trainer)
+    summary = ppo_mod.evaluate(eval_shim, state.learner_params)
+    summary["train_metrics"] = train_metrics
+
+    ckpt_dir = config.get("checkpoint_dir")
+    if ckpt_dir:
+        from gymfx_tpu.train.checkpoint import save_checkpoint
+
+        save_checkpoint(ckpt_dir, state.learner_params,
+                        step=train_metrics["total_env_steps"])
+        summary["checkpoint_dir"] = str(ckpt_dir)
+    return summary
+
+
+class _EvalShim:
+    """Duck-typed adapter exposing the trainer surface evaluate() needs."""
+
+    def __init__(self, trainer: ImpalaTrainer):
+        self.env = trainer.env
+        self.policy = trainer.policy
+        self._encode = trainer._encode
+        self._policy_forward = trainer._forward
+        self._greedy_driver = None

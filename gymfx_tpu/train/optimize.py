@@ -1,0 +1,185 @@
+"""Vectorized hyperparameter optimization (mode=optimization).
+
+The reference exposes a GA-tunable schema on its ATR bracket strategy
+((name, lo, hi, type) tuples, reference
+strategy_plugins/direct_atr_sltp.py:345-350) for an EXTERNAL optimizer
+to consume, one slow episode per candidate.  Here the optimizer is
+in-framework and TPU-shaped: because strategy hyperparameters live in
+``EnvParams`` (traced, not static), a whole POPULATION of candidates
+evaluates as one ``vmap`` over the episode scan — population-based
+search at the cost of one batched rollout per generation.
+
+Algorithm: elitist evolution — evaluate population fitness (risk-
+adjusted performance: total_return - lambda * drawdown_fraction, the
+reference's `rap`), keep the top half, refill with Gaussian mutations
+of elites clipped to the schema bounds.
+
+Note: ``atr_period`` from the reference schema sizes a ring buffer
+(static shape) and therefore cannot vary inside one compiled program;
+sweep it across separate optimize() calls if needed.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gymfx_tpu.core import env as env_core
+from gymfx_tpu.core.runtime import Environment
+
+DEFAULT_SCHEMA: Tuple[Tuple[str, float, float], ...] = (
+    ("k_sl", 1.0, 4.0),
+    ("k_tp", 1.5, 6.0),
+)
+
+
+def hparam_schema(config: Dict[str, Any]) -> List[Tuple[str, float, float]]:
+    raw = config.get("optimize_params")
+    if isinstance(raw, str):  # CLI unknown-arg path delivers a JSON string
+        import json
+
+        raw = json.loads(raw)
+    if raw:
+        return [(str(k), float(lo), float(hi)) for k, (lo, hi) in raw.items()]
+    return list(DEFAULT_SCHEMA)
+
+
+class Optimizer:
+    def __init__(
+        self,
+        env: Environment,
+        schema: Sequence[Tuple[str, float, float]],
+        *,
+        population: int = 32,
+        risk_lambda: float = 1.0,
+        mutation_scale: float = 0.15,
+        episode_steps: Optional[int] = None,
+    ):
+        self.env = env
+        self.schema = list(schema)
+        self.population = int(population)
+        if self.population < 2:
+            raise ValueError("optimize_population must be >= 2")
+        self.risk_lambda = float(risk_lambda)
+        self.mutation_scale = float(mutation_scale)
+        self.episode_steps = int(episode_steps or env.cfg.n_bars - 1)
+        for name, _, _ in self.schema:
+            if not hasattr(env.params, name):
+                raise ValueError(f"unknown hyperparameter {name!r} (not in EnvParams)")
+        self._fitness = jax.jit(self._fitness_impl)
+
+    # ------------------------------------------------------------------
+    def _with_candidate(self, vals):
+        updates = {
+            name: vals[i].astype(self.env.cfg.dtype)
+            for i, (name, _, _) in enumerate(self.schema)
+        }
+        return self.env.params._replace(**updates)
+
+    def _episode_fitness(self, vals, rng):
+        cfg, data = self.env.cfg, self.env.data
+        params = self._with_candidate(vals)
+        state, _obs = env_core.reset(cfg, params, data)
+
+        def body(carry, _):
+            state, rng = carry
+            rng, k = jax.random.split(rng)
+            action = jax.random.randint(k, (), 0, 3, dtype=jnp.int32)
+            state, _obs, _r, _done, _info = env_core.step(cfg, params, data, state, action)
+            return (state, rng), ()
+
+        (state, _), _ = jax.lax.scan(
+            body, (state, rng), None, length=self.episode_steps
+        )
+        initial = params.initial_cash
+        total_return = state.equity_delta / initial
+        dd_fraction = state.max_drawdown_pct / 100.0
+        rap = total_return - self.risk_lambda * dd_fraction
+        return rap, total_return, dd_fraction
+
+    def _fitness_impl(self, population_vals, rng):
+        # identical entry stream across candidates: fitness differences
+        # come from the hyperparameters, not from action-sampling luck
+        return jax.vmap(self._episode_fitness, in_axes=(0, None))(
+            population_vals, rng
+        )
+
+    # ------------------------------------------------------------------
+    def run(self, generations: int = 8, seed: int = 0) -> Dict[str, Any]:
+        rng = np.random.default_rng(seed)
+        lo = np.array([s[1] for s in self.schema])
+        hi = np.array([s[2] for s in self.schema])
+        pop = rng.uniform(lo, hi, size=(self.population, len(self.schema)))
+        episode_key = jax.random.PRNGKey(seed)
+
+        history = []
+        t0 = time.perf_counter()
+        best_vals, best_fit = None, -np.inf
+        for gen in range(generations):
+            rap, total_return, dd = self._fitness(
+                jnp.asarray(pop, dtype=jnp.float32), episode_key
+            )
+            rap = np.asarray(rap, np.float64)
+            order = np.argsort(-rap)
+            if rap[order[0]] > best_fit:
+                best_fit = float(rap[order[0]])
+                best_vals = pop[order[0]].copy()
+            history.append(
+                {
+                    "generation": gen,
+                    "best_rap": float(rap[order[0]]),
+                    "mean_rap": float(rap.mean()),
+                    "best_candidate": {
+                        name: float(pop[order[0]][i])
+                        for i, (name, _, _) in enumerate(self.schema)
+                    },
+                }
+            )
+            # elitist refill that preserves the population size exactly
+            # (odd sizes would otherwise shrink and force a recompile)
+            elites = pop[order[: max(1, self.population // 2)]]
+            n_fill = self.population - len(elites)
+            parents = elites[rng.integers(0, len(elites), size=n_fill)]
+            mutations = parents + rng.normal(
+                0.0, self.mutation_scale * (hi - lo), size=parents.shape
+            )
+            pop = np.clip(np.concatenate([elites, mutations], axis=0), lo, hi)
+
+        return {
+            "mode": "optimization",
+            "schema": [
+                {"name": n, "low": float(l), "high": float(h)}
+                for n, l, h in self.schema
+            ],
+            "population": self.population,
+            "generations": generations,
+            "risk_penalty_lambda": self.risk_lambda,
+            "best_params": {
+                name: float(best_vals[i])
+                for i, (name, _, _) in enumerate(self.schema)
+            },
+            "best_rap": best_fit,
+            "history": history,
+            "wall_seconds": time.perf_counter() - t0,
+        }
+
+
+def optimize_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    env = Environment(config)
+    optimizer = Optimizer(
+        env,
+        hparam_schema(config),
+        population=int(config.get("optimize_population", 32)),
+        risk_lambda=float(
+            config.get("risk_lambda", config.get("risk_penalty_lambda", 1.0))
+        ),
+        mutation_scale=float(config.get("optimize_mutation_scale", 0.15)),
+        episode_steps=config.get("steps"),
+    )
+    return optimizer.run(
+        generations=int(config.get("optimize_generations", 8)),
+        seed=int(config.get("seed", 0) or 0),
+    )

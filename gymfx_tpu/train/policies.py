@@ -74,9 +74,12 @@ class LSTMPolicy(nn.Module):
 
     def initial_carry(self, batch_shape=()):
         # (c, h) zeros — what LSTMCell.initialize_carry returns, built
-        # directly (flax modules cannot be instantiated outside a scope)
-        z = jnp.zeros((*batch_shape, self.hidden), dtype=self.dtype)
-        return (z, z)
+        # directly (flax modules cannot be instantiated outside a scope).
+        # Two distinct buffers: aliased leaves break jit donation.
+        return (
+            jnp.zeros((*batch_shape, self.hidden), dtype=self.dtype),
+            jnp.zeros((*batch_shape, self.hidden), dtype=self.dtype),
+        )
 
     def apply_seq(self, params, x, carry):
         return self.apply(params, x, carry)

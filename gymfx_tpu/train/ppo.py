@@ -27,6 +27,7 @@ import optax
 
 from gymfx_tpu.core import env as env_core
 from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.train.common import masked_reset
 from gymfx_tpu.train.policies import (
     flatten_obs,
     make_policy,
@@ -67,7 +68,7 @@ def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
         ent_coef=float(config.get("entropy_coef", 0.01)),
         vf_coef=float(config.get("value_coef", 0.5)),
         max_grad_norm=float(config.get("max_grad_norm", 0.5)),
-        policy=str(config.get("policy", "mlp")),
+        policy=str(config.get("policy") or "mlp"),
         policy_dtype=dt,
         policy_kwargs=tuple(
             (k, tuple(v) if isinstance(v, list) else v)
@@ -202,30 +203,9 @@ class PPOTrainer:
             )
             obs_vec2 = vencode(obs2)
             # auto-reset terminated envs (fresh episode, fresh carry)
-            env_states2 = jax.tree.map(
-                lambda fresh, cur: jnp.where(
-                    done.reshape(done.shape + (1,) * (cur.ndim - 1)), fresh, cur
-                ),
-                jax.tree.map(
-                    lambda x: jnp.broadcast_to(x, (done.shape[0], *x.shape)),
-                    reset_state,
-                ),
-                env_states2,
-            )
-            obs_vec2 = jnp.where(
-                done.reshape(done.shape + (1,) * (obs_vec2.ndim - 1)),
-                reset_vec,
-                obs_vec2,
-            )
-            pcarry2 = jax.tree.map(
-                lambda fresh, cur: jnp.where(
-                    done.reshape(done.shape + (1,) * (cur.ndim - 1)),
-                    jnp.broadcast_to(fresh, cur.shape),
-                    cur,
-                ),
-                carry0,
-                pcarry2,
-            )
+            env_states2 = masked_reset(done, reset_state, env_states2)
+            obs_vec2 = masked_reset(done, reset_vec, obs_vec2)
+            pcarry2 = masked_reset(done, carry0, pcarry2)
             out = dict(
                 obs=obs_vec, action=action, logp=logp, value=value,
                 reward=reward.astype(jnp.float32), done=done,

@@ -1,0 +1,80 @@
+"""Vectorized hyperparameter optimization (mode=optimization; the
+reference exposes the GA schema direct_atr_sltp.py:345-350 for an
+external optimizer — here the population evaluates as one vmap)."""
+import numpy as np
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+from gymfx_tpu.train.optimize import Optimizer, hparam_schema
+from tests.helpers import make_df
+
+
+def _noisy_df(n=150, seed=5):
+    rng = np.random.default_rng(seed)
+    closes = 1.1 + np.cumsum(rng.normal(0, 3e-4, n))
+    return make_df(closes, highs=closes + 4e-4, lows=closes - 4e-4)
+
+
+def _env(**over):
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1",
+                  strategy_plugin="direct_atr_sltp", atr_period=5,
+                  position_size=2000.0)
+    config.update(over)
+    return Environment(config, dataset=MarketDataset(_noisy_df(), config))
+
+
+def test_optimizer_runs_and_improves_or_holds_best():
+    env = _env()
+    opt = Optimizer(env, [("k_sl", 1.0, 4.0), ("k_tp", 1.5, 6.0)],
+                    population=8, episode_steps=100)
+    result = opt.run(generations=3, seed=1)
+    assert len(result["history"]) == 3
+    bests = [h["best_rap"] for h in result["history"]]
+    assert result["best_rap"] == pytest.approx(max(bests))
+    assert set(result["best_params"]) == {"k_sl", "k_tp"}
+    assert 1.0 <= result["best_params"]["k_sl"] <= 4.0
+
+
+def test_candidates_actually_change_outcomes():
+    import jax
+    import jax.numpy as jnp
+
+    env = _env(commission=1e-4)
+    opt = Optimizer(env, [("k_sl", 1.0, 4.0), ("k_tp", 1.5, 6.0)],
+                    population=6, episode_steps=100)
+    pop = jnp.asarray(
+        [[1.0, 1.5], [4.0, 6.0], [2.0, 3.0], [1.2, 5.5], [3.7, 2.0], [2.5, 2.5]],
+        jnp.float32,
+    )
+    rap, tr, dd = opt._fitness(pop, jax.random.PRNGKey(0))
+    assert len({round(float(x), 9) for x in rap}) > 1  # not all identical
+
+
+def test_unknown_hparam_rejected():
+    env = _env()
+    with pytest.raises(ValueError, match="unknown hyperparameter"):
+        Optimizer(env, [("magic", 0.0, 1.0)])
+
+
+def test_schema_override_from_config():
+    schema = hparam_schema({"optimize_params": {"rel_volume": [0.01, 0.2]}})
+    assert schema == [("rel_volume", 0.01, 0.2)]
+    assert hparam_schema({})[0][0] == "k_sl"
+
+
+def test_cli_optimization_mode(tmp_path):
+    from gymfx_tpu.app.main import main
+
+    s = main([
+        "--mode", "optimization",
+        "--input_data_file", "examples/data/eurusd_sample.csv",
+        "--strategy_plugin", "direct_atr_sltp",
+        "--steps", "80", "--quiet_mode",
+        "--optimize_population", "6", "--optimize_generations", "2",
+        "--results_file", str(tmp_path / "opt.json"),
+    ])
+    assert s["mode"] == "optimization"
+    assert "best_params" in s and "k_sl" in s["best_params"]

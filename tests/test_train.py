@@ -115,3 +115,93 @@ def test_repeated_evaluate_reuses_compiled_episode():
     after = rollout_mod.rollout._cache_size()
     assert after == before  # second eval hit the jit cache
     assert "total_return" in s2
+
+
+def _impala_trainer(df=None, **over):
+    from gymfx_tpu.train.impala import ImpalaTrainer, impala_config_from
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=8, impala_unroll=16,
+                  policy="lstm", policy_kwargs={})
+    config.update(over)
+    df = uptrend_df(120) if df is None else df
+    env = Environment(config, dataset=MarketDataset(df, config))
+    return ImpalaTrainer(env, impala_config_from(config))
+
+
+def test_impala_train_step_runs_lstm():
+    import jax
+
+    tr = _impala_trainer()
+    s = tr.init_state(0)
+    before = [np.asarray(x).copy() for x in jax.tree.leaves(s.learner_params)]
+    s, m = tr.train_step(s)
+    for key in ("loss", "policy_loss", "value_loss", "entropy", "mean_rho"):
+        assert np.isfinite(float(m[key])), key
+    after = jax.tree.leaves(s.learner_params)
+    assert any(
+        not np.array_equal(a, np.asarray(b)) for a, b in zip(before, after)
+    )
+
+
+def test_impala_actor_sync_staleness():
+    import jax
+
+    tr = _impala_trainer(impala_sync_every=3)
+    s = tr.init_state(0)
+    s, _ = tr.train_step(s)  # count 1: actors stale
+    stale = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(s.learner_params),
+                        jax.tree.leaves(s.actor_params))
+    )
+    assert stale
+    s, _ = tr.train_step(s)  # count 2
+    s, _ = tr.train_step(s)  # count 3 -> sync
+    synced = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(s.learner_params),
+                        jax.tree.leaves(s.actor_params))
+    )
+    assert synced
+    assert int(s.updates_since_sync) == 0
+
+
+def test_impala_vtrace_reduces_to_onpolicy_returns():
+    # with rho = c = 1 (on-policy), vs should equal discounted TD(lambda=1)
+    # targets; verify against a direct numpy recursion
+    tr = _impala_trainer()
+    import jax.numpy as jnp
+
+    T, N = 6, 3
+    rng = np.random.default_rng(0)
+    values = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    bootstrap = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    rewards = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    dones = jnp.zeros((T, N), bool)
+    rhos = jnp.ones((T, N), jnp.float32)
+    vs, pg_adv = tr._vtrace(values, bootstrap, rewards, dones, rhos)
+
+    g = tr.icfg.gamma
+    v = np.asarray(values)
+    vn = np.concatenate([v[1:], np.asarray(bootstrap)[None]], 0)
+    deltas = np.asarray(rewards) + g * vn - v
+    acc = np.zeros(N, np.float32)
+    out = np.zeros((T, N), np.float32)
+    for t in reversed(range(T)):
+        acc = deltas[t] + g * acc
+        out[t] = acc
+    np.testing.assert_allclose(np.asarray(vs), v + out, rtol=1e-5, atol=1e-5)
+
+
+def test_impala_from_config_cli_path(tmp_path):
+    from gymfx_tpu.app.main import main
+
+    s = main([
+        "--mode", "training", "--input_data_file", "examples/data/eurusd_uptrend.csv",
+        "--num_envs", "4", "--train_total_steps", "256",
+        "--results_file", str(tmp_path / "r.json"), "--quiet_mode",
+        "--trainer", "impala", "--impala_unroll", "16", "--window_size", "8",
+    ])
+    assert "train_metrics" in s and np.isfinite(s["train_metrics"]["loss"])
+    assert "total_return" in s
