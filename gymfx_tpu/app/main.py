@@ -75,10 +75,15 @@ def run_mode(config: Dict[str, Any]) -> Dict[str, Any]:
     loop (the reference validates the mode but runs the same loop for
     all three — app/main.py:84; training/policy are new capability)."""
     if config.get("mode") == "training":
-        if str(config.get("trainer", "ppo")).lower() == "impala":
+        trainer = str(config.get("trainer", "ppo")).lower()
+        if trainer == "impala":
             from gymfx_tpu.train.impala import train_impala_from_config
 
             return train_impala_from_config(config)
+        if trainer == "pbt":
+            from gymfx_tpu.train.pbt import train_pbt_from_config
+
+            return train_pbt_from_config(config)
         from gymfx_tpu.train.ppo import train_from_config
 
         return train_from_config(config)

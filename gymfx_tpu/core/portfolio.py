@@ -1,0 +1,374 @@
+"""Multi-pair portfolio environment (BASELINE.json config 5).
+
+New capability: the reference env trades a single instrument; its only
+multi-asset surface is the Nautilus replay fixture.  Here the portfolio
+env is a first-class scan kernel over I instruments simultaneously:
+positions, pending orders and pnl conversion are (I,)-vectors, one step
+advances all pairs in lockstep, and the whole thing jits/vmaps/shards
+exactly like the single-pair core.
+
+Accounting: one account currency; each pair carries a per-bar
+conversion factor from its quote currency to the account currency
+(precomputed host-side: 1 for XXX/ACC pairs, 1/price for ACC/XXX
+pairs — the same direct-pair rule as the reconciliation oracle,
+simulation/oracle.py).  Cash effects of fills and mark-to-market pnl
+convert at the bar where they occur.
+
+Timing matches the single-pair kernel: actions at bar t create pending
+orders that fill at bar t+1's open; equity marks at every close; the
+first step is the same-bar warmup.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+
+class PortfolioData(NamedTuple):
+    open: Any      # (n, I)
+    high: Any      # (n, I)
+    low: Any       # (n, I)
+    close: Any     # (n, I)
+    conv: Any      # (n, I) quote->account conversion factor
+    padded_close: Any  # (n + w, I)
+
+    @property
+    def n_bars(self) -> int:
+        return int(self.close.shape[0])
+
+    @property
+    def n_pairs(self) -> int:
+        return int(self.close.shape[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class PortfolioConfig:
+    n_pairs: int
+    n_bars: int
+    window_size: int = 32
+    margin_rate: float = 0.0   # 0 disables the margin preflight
+    dtype: Any = jnp.float32
+
+
+class PortfolioParams(NamedTuple):
+    initial_cash: Any
+    position_size: Any     # (I,) units per order
+    commission: Any
+    slippage: Any
+    leverage: Any
+    min_equity: Any
+    reward_scale: Any
+
+
+class PortfolioState(NamedTuple):
+    t: Any
+    started: Any
+    terminated: Any
+    pos: Any               # (I,) signed units
+    entry: Any             # (I,) avg entry price
+    cash_delta: Any        # scalar, account currency
+    equity_delta: Any
+    prev_equity_delta: Any
+    commission_paid: Any
+    trade_count: Any       # i32 scalar
+    pending_active: Any    # (I,) bool
+    pending_target: Any    # (I,)
+    blocked_margin: Any    # i32 counter
+
+
+def load_portfolio_frames(
+    files: Dict[str, str],
+    *,
+    date_column: str = "DATE_TIME",
+    price_column: str = "CLOSE",
+    max_rows: Optional[int] = None,
+) -> Tuple[List[str], Dict[str, pd.DataFrame]]:
+    """Load and time-align several pair CSVs on their shared timestamps
+    (inner join).  Returns (pair names, per-pair aligned frames)."""
+    frames: Dict[str, pd.DataFrame] = {}
+    for pair, path in files.items():
+        df = pd.read_csv(path, nrows=max_rows)
+        df[date_column] = pd.to_datetime(df[date_column], errors="coerce")
+        df = df.dropna(subset=[date_column]).set_index(date_column)
+        for col in ("OPEN", "HIGH", "LOW", "CLOSE"):
+            if col not in df.columns:
+                df[col] = df[price_column]
+        frames[pair] = df
+    common = None
+    for df in frames.values():
+        common = df.index if common is None else common.intersection(df.index)
+    if common is None or len(common) < 3:
+        raise ValueError("portfolio pairs share too few timestamps")
+    aligned = {pair: df.loc[common] for pair, df in frames.items()}
+    return list(files.keys()), aligned
+
+
+def build_portfolio_data(
+    pairs: Sequence[str],
+    aligned: Dict[str, pd.DataFrame],
+    *,
+    window_size: int,
+    account_currency: str = "USD",
+    dtype: Any = jnp.float32,
+) -> PortfolioData:
+    n = len(next(iter(aligned.values())))
+    cols = {k: np.stack([aligned[p][k].to_numpy(np.float64) for p in pairs], 1)
+            for k in ("OPEN", "HIGH", "LOW", "CLOSE")}
+    conv = np.ones((n, len(pairs)))
+    for i, pair in enumerate(pairs):
+        base, _, quote = pair.replace("/", "_").partition("_")
+        if quote == account_currency:
+            conv[:, i] = 1.0
+        elif base == account_currency:
+            conv[:, i] = 1.0 / cols["CLOSE"][:, i]
+        else:
+            raise ValueError(
+                f"pair {pair}: no direct conversion from {quote} to "
+                f"{account_currency}; crosses need a bridging pair"
+            )
+    padded = np.concatenate(
+        [np.tile(cols["CLOSE"][:1], (window_size, 1)), cols["CLOSE"]], axis=0
+    )
+    return PortfolioData(
+        open=jnp.asarray(cols["OPEN"], dtype),
+        high=jnp.asarray(cols["HIGH"], dtype),
+        low=jnp.asarray(cols["LOW"], dtype),
+        close=jnp.asarray(cols["CLOSE"], dtype),
+        conv=jnp.asarray(conv, dtype),
+        padded_close=jnp.asarray(padded, dtype),
+    )
+
+
+# ---------------------------------------------------------------------------
+def reset(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData):
+    d = cfg.dtype
+    I = cfg.n_pairs
+    z = jnp.zeros((), d)
+    state = PortfolioState(
+        t=jnp.zeros((), jnp.int32),
+        started=jnp.zeros((), bool),
+        terminated=jnp.zeros((), bool),
+        pos=jnp.zeros((I,), d),
+        entry=jnp.zeros((I,), d),
+        cash_delta=z,
+        equity_delta=z,
+        prev_equity_delta=z,
+        commission_paid=z,
+        trade_count=jnp.zeros((), jnp.int32),
+        pending_active=jnp.zeros((I,), bool),
+        pending_target=jnp.zeros((I,), d),
+        blocked_margin=jnp.zeros((), jnp.int32),
+    )
+    return state, build_obs(state, data, cfg, params)
+
+
+def build_obs(state, data: PortfolioData, cfg: PortfolioConfig, params):
+    w = cfg.window_size
+    step = jnp.minimum(state.t + 1, cfg.n_bars)
+    prices = jax.lax.dynamic_slice(
+        data.padded_close, (step, jnp.zeros((), step.dtype)), (w, cfg.n_pairs)
+    )
+    returns = prices - jnp.concatenate([prices[:1], prices[:-1]])
+    initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
+    return {
+        "prices": prices.astype(jnp.float32),
+        "returns": returns.astype(jnp.float32),
+        "position": jnp.sign(state.pos).astype(jnp.float32),
+        "equity_norm": jnp.asarray(
+            [state.equity_delta / initial], jnp.float32
+        ),
+        "steps_remaining_norm": jnp.asarray(
+            [jnp.maximum(0, cfg.n_bars - (state.t + 1)) / max(1, cfg.n_bars)],
+            jnp.float32,
+        ),
+    }
+
+
+def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
+         state: PortfolioState, actions):
+    """actions: (I,) ints in {0=hold, 1=long, 2=short, 3=flat}."""
+    n = cfg.n_bars
+    was_terminated = state.terminated
+    live = ~was_terminated
+    a = jnp.asarray(actions, jnp.int32).reshape(cfg.n_pairs)
+    a = jnp.where((a >= 0) & (a <= 3), a, 0)
+
+    advance = live & state.started & (state.t < n - 1)
+    exhausted = live & state.started & (state.t >= n - 1)
+    act = live & ~exhausted
+
+    t_new = jnp.where(advance, state.t + 1, state.t)
+    o = data.open[t_new]      # (I,)
+    c = data.close[t_new]
+    conv = data.conv[t_new]
+
+    pos, entry, cash = state.pos, state.entry, state.cash_delta
+    commission_paid = state.commission_paid
+    trade_count = state.trade_count
+
+    # ---- fill pending orders at the new bar's open -------------------
+    do_fill = advance & state.pending_active
+    target = jnp.where(do_fill, state.pending_target, pos)
+    delta = target - pos
+    direction = jnp.sign(delta)
+    fill = o * (1.0 + params.slippage * direction)
+    commission = params.commission * fill * jnp.abs(delta) * conv
+    # realized pnl on closed units, converted to the account currency
+    same_sign = pos * target > 0
+    closed = jnp.where(same_sign, jnp.maximum(jnp.abs(pos) - jnp.abs(target), 0.0),
+                       jnp.abs(pos))
+    closed = jnp.where(delta == 0, 0.0, closed)
+    realized = closed * (fill - entry) * jnp.sign(pos) * conv
+    cash = cash + jnp.sum(realized - commission)
+    commission_paid = commission_paid + jnp.sum(commission)
+
+    flipping = (~same_sign) & (target != 0) & (pos != 0)
+    opening = (pos == 0) & (target != 0)
+    adding = same_sign & (jnp.abs(target) > jnp.abs(pos))
+    new_entry = jnp.where(
+        adding,
+        (entry * jnp.abs(pos) + fill * (jnp.abs(target) - jnp.abs(pos)))
+        / jnp.maximum(jnp.abs(target), 1e-30),
+        entry,
+    )
+    new_entry = jnp.where(flipping | opening, fill, new_entry)
+    new_entry = jnp.where(target == 0, 0.0, new_entry)
+    trade_closed = (pos != 0) & ((target == 0) | flipping)
+    trade_count = trade_count + jnp.sum(trade_closed.astype(jnp.int32))
+    pos = target
+    entry = new_entry
+
+    # ---- apply new actions at the close ------------------------------
+    size = params.position_size
+    want = jnp.where(
+        a == 1, size, jnp.where(a == 2, -size, jnp.where(a == 3, 0.0, jnp.nan))
+    )
+    submit = act & (a != 0) & (
+        (a == 3) & (pos != 0)
+        | (a == 1) & (pos <= 0)
+        | (a == 2) & (pos >= 0)
+    )
+    new_target = jnp.where(submit, jnp.nan_to_num(want), pos)
+
+    # optional margin preflight on the TOTAL post-fill book
+    if cfg.margin_rate > 0:
+        notional = jnp.sum(jnp.abs(new_target) * c * conv)
+        equity_now = params.initial_cash + cash + jnp.sum(pos * (c - entry) * conv)
+        required = notional * cfg.margin_rate / jnp.maximum(params.leverage, 1e-12)
+        margin_ok = required <= equity_now
+        blocked = submit & ~margin_ok & (jnp.abs(new_target) > jnp.abs(pos))
+        new_target = jnp.where(blocked, pos, new_target)
+        submit = submit & ~blocked
+        state_blocked = state.blocked_margin + jnp.sum(blocked.astype(jnp.int32))
+    else:
+        state_blocked = state.blocked_margin
+
+    pending_active = jnp.where(act, submit & (new_target != pos), False)
+    pending_target = jnp.where(pending_active, new_target, 0.0)
+
+    # ---- mark to market ----------------------------------------------
+    unrealized = jnp.sum(pos * (c - entry) * conv)
+    equity_delta = jnp.where(
+        advance | (live & ~state.started), cash + unrealized, state.equity_delta
+    )
+    prev_equity_delta = jnp.where(
+        advance | (live & ~state.started), state.equity_delta,
+        state.prev_equity_delta,
+    )
+
+    initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
+    reward = jnp.where(
+        live, (equity_delta - prev_equity_delta) / initial * params.reward_scale, 0.0
+    )
+    equity = params.initial_cash + equity_delta
+    terminated = was_terminated | exhausted | (live & (equity <= params.min_equity))
+
+    new_state = PortfolioState(
+        t=t_new,
+        started=state.started | live,
+        terminated=terminated,
+        pos=jnp.where(advance, pos, state.pos),
+        entry=jnp.where(advance, entry, state.entry),
+        cash_delta=jnp.where(advance, cash, state.cash_delta),
+        equity_delta=equity_delta,
+        prev_equity_delta=prev_equity_delta,
+        commission_paid=jnp.where(advance, commission_paid, state.commission_paid),
+        trade_count=jnp.where(advance, trade_count, state.trade_count),
+        pending_active=pending_active,
+        pending_target=pending_target,
+        blocked_margin=state_blocked,
+    )
+    obs = build_obs(new_state, data, cfg, params)
+    info = {
+        "equity": equity,
+        "equity_delta": equity_delta,
+        "positions": jnp.sign(new_state.pos).astype(jnp.int32),
+        "position_units": new_state.pos,
+        "bar_index": t_new + 1,
+        "trades": new_state.trade_count,
+        "commission_paid": new_state.commission_paid,
+        "blocked_margin": new_state.blocked_margin,
+    }
+    return new_state, obs, reward, terminated, info
+
+
+# ---------------------------------------------------------------------------
+class PortfolioEnvironment:
+    """Host-side binding: pair CSVs -> jitted portfolio reset/step."""
+
+    def __init__(self, config: Dict[str, Any]):
+        files = config.get("portfolio_files")
+        if not files:
+            raise ValueError("portfolio env requires config['portfolio_files']")
+        account = str(config.get("account_currency", "USD"))
+        pairs, aligned = load_portfolio_frames(
+            dict(files),
+            date_column=str(config.get("date_column", "DATE_TIME")),
+            price_column=str(config.get("price_column", "CLOSE")),
+            max_rows=config.get("max_rows"),
+        )
+        self.pairs = pairs
+        w = int(config.get("window_size", 32))
+        self.data = build_portfolio_data(
+            pairs, aligned, window_size=w, account_currency=account
+        )
+        self.cfg = PortfolioConfig(
+            n_pairs=len(pairs),
+            n_bars=self.data.n_bars,
+            window_size=w,
+            margin_rate=float(config.get("margin_rate", 0.0)),
+        )
+        d = self.cfg.dtype
+        initial_cash = float(config.get("initial_cash", 10000.0))
+        min_eq = config.get("min_equity")
+        sizes = config.get("portfolio_position_sizes")
+        if sizes is None:
+            sizes = [float(config.get("position_size", 1.0))] * len(pairs)
+        self.params = PortfolioParams(
+            initial_cash=jnp.asarray(initial_cash, d),
+            position_size=jnp.asarray(sizes, d),
+            commission=jnp.asarray(float(config.get("commission", 0.0)), d),
+            slippage=jnp.asarray(
+                float(config.get("slippage_perc", config.get("slippage", 0.0)) or 0.0), d
+            ),
+            leverage=jnp.asarray(float(config.get("leverage", 1.0)), d),
+            min_equity=jnp.asarray(
+                float(initial_cash * 0.01 if min_eq is None else min_eq), d
+            ),
+            reward_scale=jnp.asarray(float(config.get("reward_scale", 1.0)), d),
+        )
+
+    def reset(self):
+        return _jit_p_reset(self.cfg, self.params, self.data)
+
+    def step(self, state, actions):
+        return _jit_p_step(self.cfg, self.params, self.data, state, actions)
+
+
+_jit_p_reset = jax.jit(reset, static_argnums=0)
+_jit_p_step = jax.jit(step, static_argnums=0)

@@ -1,0 +1,1 @@
+from gymfx_tpu.ops.window_zscore import batched_scaled_windows  # noqa: F401

@@ -1,0 +1,124 @@
+"""Pallas TPU kernel: fused window gather + leakage-safe z-score + clip.
+
+The feature-window observation is the reference's per-step hot spot
+(reference preprocessor_plugins/feature_window_preprocessor.py:174-191:
+slice + z-score over up to 256 history rows per step per env).  The
+scan env already reduces that to an O(1) dynamic-slice + normalize; this
+kernel covers the BATCHED form — materializing scaled windows for many
+steps/envs at once (offline featurization, eval sweeps, replay-buffer
+exports) — as one fused pass: for each requested step, DMA the window
+rows from HBM into VMEM, normalize with that step's precomputed
+scaler moments, clip, and write the scaled window.  One kernel instead
+of gather + sub + div + clip materializing (B, w, F) intermediates in
+HBM three times.
+
+Falls back to pallas interpret mode off-TPU, so tests run on CPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(steps_ref, feat_hbm, mean_ref, std_ref, neutral_ref, out_ref,
+            scratch, sem, *, window: int, clip: float):
+    b = pl.program_id(0)
+    start = steps_ref[b]
+    copy = pltpu.make_async_copy(
+        feat_hbm.at[pl.ds(start, window), :], scratch, sem
+    )
+    copy.start()
+    copy.wait()
+    win = scratch[:]
+    # moments live whole in VMEM; pick this step's row dynamically
+    mean = mean_ref[pl.ds(start, 1), :]  # (1, F)
+    std = std_ref[pl.ds(start, 1), :]
+    neutral = neutral_ref[pl.ds(start, 1), :][0, 0]
+    scaled = jnp.where(neutral != 0, 0.0, (win - mean) / std)
+    if clip > 0:
+        scaled = jnp.clip(scaled, -clip, clip)
+    out_ref[0] = scaled
+
+
+@functools.partial(jax.jit, static_argnames=("window", "clip", "interpret"))
+def batched_scaled_windows(
+    padded_features,  # (n + window, F) float32
+    feat_mean,        # (n + 1, F)
+    feat_std,         # (n + 1, F)
+    feat_neutral,     # (n + 1,) bool
+    steps,            # (B,) int32 — window ends (exclusive) at row `step`
+    *,
+    window: int,
+    clip: float = 10.0,
+    interpret: bool | None = None,
+):
+    """Scaled feature windows for a batch of steps: (B, window, F)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b = steps.shape[0]
+    f = orig_f = padded_features.shape[-1]
+    steps = steps.astype(jnp.int32)
+
+    if window % 8 != 0:
+        raise ValueError("window must be a multiple of 8 (TPU sublane tiling)")
+
+    # Lane-align the feature axis: Mosaic DMA slices must be 128-aligned
+    # on the last dimension.  Pad features/means with zeros and stds with
+    # ones (benign division), slice the result back to F at the end.
+    f_pad = max(128, -(-f // 128) * 128) if not interpret else f
+    if f_pad != f:
+        pad = ((0, 0), (0, f_pad - f))
+        padded_features = jnp.pad(padded_features, pad)
+        feat_mean = jnp.pad(feat_mean, pad)
+        feat_std = jnp.pad(feat_std, pad, constant_values=1.0)
+        f = f_pad
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),   # features stay in HBM
+            pl.BlockSpec(memory_space=pltpu.VMEM),  # moments whole in VMEM
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, window, f), lambda i, steps_ref: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((window, f), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kernel = functools.partial(_kernel, window=window, clip=float(clip))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, window, f), jnp.float32),
+        interpret=interpret,
+    )(steps, padded_features, feat_mean, feat_std,
+      feat_neutral.astype(jnp.int32).reshape(-1, 1))
+    return out[:, :, :orig_f]
+
+
+def reference_scaled_windows(
+    padded_features, feat_mean, feat_std, feat_neutral, steps, *, window, clip=10.0
+):
+    """Plain-XLA reference implementation (for parity tests and as the
+    fallback path on backends without pallas support)."""
+
+    def one(step):
+        win = jax.lax.dynamic_slice(
+            padded_features, (step, jnp.zeros((), step.dtype)),
+            (window, padded_features.shape[-1]),
+        )
+        scaled = jnp.where(
+            feat_neutral[step], 0.0, (win - feat_mean[step]) / feat_std[step]
+        )
+        if clip > 0:
+            scaled = jnp.clip(scaled, -clip, clip)
+        return scaled
+
+    return jax.vmap(one)(steps.astype(jnp.int32))

@@ -3,19 +3,37 @@ training checkpointing; closest mechanisms are action replay and config
 save/restore, SURVEY.md §5.4)."""
 from __future__ import annotations
 
+import json
 from pathlib import Path
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import orbax.checkpoint as ocp
 
 
-def save_checkpoint(directory: str, params: Any, step: int = 0) -> str:
+def save_checkpoint(
+    directory: str,
+    params: Any,
+    step: int = 0,
+    metadata: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Save params (+ a metadata.json describing e.g. which policy
+    architecture produced them, so evaluation can rebuild the right
+    template without the user re-passing --policy)."""
     path = Path(directory).resolve()
     path.mkdir(parents=True, exist_ok=True)
     with ocp.CheckpointManager(path) as mngr:
         mngr.save(int(step), args=ocp.args.StandardSave(params))
         mngr.wait_until_finished()
+    if metadata is not None:
+        (path / "metadata.json").write_text(json.dumps(metadata, indent=2))
     return str(path)
+
+
+def read_metadata(directory: str) -> Dict[str, Any]:
+    meta = Path(directory).resolve() / "metadata.json"
+    if meta.exists():
+        return json.loads(meta.read_text())
+    return {}
 
 
 def load_checkpoint(directory: str, template: Optional[Any] = None) -> Tuple[Any, int]:

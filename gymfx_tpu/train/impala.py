@@ -127,7 +127,7 @@ class ImpalaTrainer:
             p = self.policy.init(k, self._reset_vec)
         n = self.icfg.n_envs
         bcast = lambda x: jnp.broadcast_to(x, (n, *x.shape))  # noqa: E731
-        return ImpalaState(
+        state = ImpalaState(
             learner_params=p,
             # distinct buffers: learner and actor trees are both donated
             # by the jitted step, and XLA rejects donating one buffer twice
@@ -139,6 +139,22 @@ class ImpalaTrainer:
             rng=rng,
             updates_since_sync=jnp.zeros((), jnp.int32),
         )
+        if self.mesh is not None:
+            from gymfx_tpu.train.common import shard_train_state
+
+            state = state._replace(
+                **shard_train_state(
+                    self.mesh,
+                    params={"learner_params": state.learner_params,
+                            "actor_params": state.actor_params},
+                    replicated={"opt_state": state.opt_state, "rng": state.rng,
+                                "updates_since_sync": state.updates_since_sync},
+                    batched={"env_states": state.env_states,
+                             "obs_vec": state.obs_vec,
+                             "policy_carry": state.policy_carry},
+                )
+            )
+        return state
 
     # ------------------------------------------------------------------
     def _rollout(self, actor_params, env_states, obs_vec, pcarry, rng):
@@ -323,8 +339,12 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     if ckpt_dir:
         from gymfx_tpu.train.checkpoint import save_checkpoint
 
-        save_checkpoint(ckpt_dir, state.learner_params,
-                        step=train_metrics["total_env_steps"])
+        save_checkpoint(
+            ckpt_dir, state.learner_params,
+            step=train_metrics["total_env_steps"],
+            metadata={"policy": icfg.policy,
+                      "policy_kwargs": dict(icfg.policy_kwargs)},
+        )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary
 

@@ -1,0 +1,191 @@
+"""Population-based training (BASELINE.json config 5: "pod-scale
+population-based training").
+
+TPU-shaped PBT: the whole population trains as ONE program — member
+train states are stacked on a leading population axis and the PPO train
+step is ``vmap``-ed across it, so P members cost one batched step (and
+shard over mesh devices at pod scale).  Per-member learning rates live
+inside the optimizer state via ``optax.inject_hyperparams``, which is
+what makes them traced (vmappable) instead of compile-time constants.
+
+Exploit/explore (Jaderberg et al. 2017), every ``interval`` steps:
+members in the bottom quantile copy the params + optimizer state of a
+random top-quantile member and perturb their learning rate by x0.8 or
+x1.25 (clipped to bounds).  Fitness = running mean reward of the
+member's own rollouts.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.train.ppo import PPOConfig, PPOTrainer, ppo_config_from
+
+
+class PBTConfig(NamedTuple):
+    population: int = 8
+    interval: int = 5            # train steps between exploit/explore
+    quantile: float = 0.25
+    lr_min: float = 1e-5
+    lr_max: float = 1e-2
+    perturb: float = 1.25
+    fitness_decay: float = 0.7   # EMA over per-step mean reward
+
+
+class _PBTTrainerCore(PPOTrainer):
+    """PPOTrainer with the learning rate injected into opt_state."""
+
+    def _make_optimizer(self):
+        def make(learning_rate):
+            return optax.chain(
+                optax.clip_by_global_norm(self.pcfg.max_grad_norm),
+                optax.adam(learning_rate),
+            )
+
+        return optax.inject_hyperparams(make)(learning_rate=self.pcfg.lr)
+
+
+class PBTTrainer:
+    def __init__(
+        self,
+        env: Environment,
+        pcfg: PPOConfig,
+        pbt: PBTConfig = PBTConfig(),
+    ):
+        self.trainer = _PBTTrainerCore(env, pcfg)
+        self.pbt = pbt
+        self._vstep = jax.jit(jax.vmap(self.trainer._train_step_impl), donate_argnums=0)
+        self._vinit = jax.jit(jax.vmap(self.trainer.init_state_from_key))
+
+    # ------------------------------------------------------------------
+    def init_population(self, seed: int = 0):
+        keys = jax.random.split(jax.random.PRNGKey(seed), self.pbt.population)
+        states = self._vinit(keys)
+        rng = np.random.default_rng(seed)
+        lrs = np.exp(
+            rng.uniform(
+                np.log(self.pbt.lr_min), np.log(self.pbt.lr_max),
+                self.pbt.population,
+            )
+        )
+        states = self._set_lrs(states, jnp.asarray(lrs, jnp.float32))
+        fitness = np.zeros(self.pbt.population)
+        return states, fitness
+
+    def _set_lrs(self, states, lrs):
+        opt_state = states.opt_state
+        hyper = dict(opt_state.hyperparams)
+        hyper["learning_rate"] = lrs.astype(
+            hyper["learning_rate"].dtype
+        )
+        return states._replace(opt_state=opt_state._replace(hyperparams=hyper))
+
+    def get_lrs(self, states) -> np.ndarray:
+        return np.asarray(states.opt_state.hyperparams["learning_rate"])
+
+    # ------------------------------------------------------------------
+    def _exploit_explore(self, states, fitness, rng):
+        P = self.pbt.population
+        k = max(1, int(P * self.pbt.quantile))
+        order = np.argsort(fitness)          # ascending
+        bottom, top = order[:k], order[-k:]
+        src_for = {int(b): int(top[rng.integers(0, len(top))]) for b in bottom}
+
+        idx = np.arange(P)
+        for b, s in src_for.items():
+            idx[b] = s
+        idx_dev = jnp.asarray(idx)
+        # bottom members copy params + optimizer state (incl. lr) of donors
+        copied = jax.tree.map(lambda x: x[idx_dev], (states.params, states.opt_state))
+        states = states._replace(params=copied[0], opt_state=copied[1])
+
+        lrs = self.get_lrs(states).copy()
+        for b in src_for:
+            factor = self.pbt.perturb if rng.random() < 0.5 else 1.0 / self.pbt.perturb
+            lrs[b] = float(np.clip(lrs[b] * factor, self.pbt.lr_min, self.pbt.lr_max))
+        states = self._set_lrs(states, jnp.asarray(lrs, jnp.float32))
+        fitness[list(src_for)] = fitness[[src_for[b] for b in src_for]]
+        return states, fitness, sorted(src_for)
+
+    # ------------------------------------------------------------------
+    def train(self, total_env_steps: int, seed: int = 0) -> Dict[str, Any]:
+        pcfg = self.trainer.pcfg
+        per_iter = pcfg.n_envs * pcfg.horizon * self.pbt.population
+        iters = max(1, int(total_env_steps) // per_iter)
+        states, fitness = self.init_population(seed)
+        rng = np.random.default_rng(seed + 1)
+        decay = self.pbt.fitness_decay
+        replacements = []
+        t0 = time.perf_counter()
+        metrics = {}
+        for it in range(iters):
+            states, metrics = self._vstep(states)
+            step_fit = np.asarray(metrics["mean_reward"], np.float64)
+            fitness = decay * fitness + (1 - decay) * step_fit
+            if (it + 1) % self.pbt.interval == 0 and it + 1 < iters:
+                states, fitness, replaced = self._exploit_explore(
+                    states, fitness, rng
+                )
+                replacements.append({"iter": it + 1, "replaced": replaced})
+        jax.block_until_ready(states.params)
+        dt = time.perf_counter() - t0
+
+        best = int(np.argmax(fitness))
+        best_params = jax.tree.map(lambda x: x[best], states.params)
+        return {
+            "population": self.pbt.population,
+            "iterations": iters,
+            "total_env_steps": per_iter * iters,
+            "env_steps_per_sec": per_iter * iters / dt,
+            "fitness": fitness.tolist(),
+            "learning_rates": self.get_lrs(states).tolist(),
+            "best_member": best,
+            "best_params": best_params,
+            "replacements": replacements,
+            "final_metrics": {
+                k: np.asarray(v).tolist() for k, v in metrics.items()
+            },
+        }
+
+
+def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    env = Environment(config)
+    pcfg = ppo_config_from(config)
+    pbt = PBTConfig(
+        population=int(config.get("pbt_population", 8)),
+        interval=int(config.get("pbt_interval", 5)),
+        quantile=float(config.get("pbt_quantile", 0.25)),
+        lr_min=float(config.get("pbt_lr_min", 1e-5)),
+        lr_max=float(config.get("pbt_lr_max", 1e-2)),
+        perturb=float(config.get("pbt_perturb", 1.25)),
+        fitness_decay=float(config.get("pbt_fitness_decay", 0.7)),
+    )
+    trainer = PBTTrainer(env, pcfg, pbt)
+    result = trainer.train(
+        int(config.get("train_total_steps", 1_000_000)),
+        seed=int(config.get("seed", 0) or 0),
+    )
+    best_params = result.pop("best_params")
+
+    from gymfx_tpu.train import ppo as ppo_mod
+
+    summary = ppo_mod.evaluate(trainer.trainer, best_params)
+    summary["pbt"] = result
+
+    ckpt_dir = config.get("checkpoint_dir")
+    if ckpt_dir:
+        from gymfx_tpu.train.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            ckpt_dir, best_params, step=result["total_env_steps"],
+            metadata={"policy": pcfg.policy,
+                      "policy_kwargs": dict(pcfg.policy_kwargs)},
+        )
+        summary["checkpoint_dir"] = str(ckpt_dir)
+    return summary

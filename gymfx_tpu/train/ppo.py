@@ -96,10 +96,7 @@ class PPOTrainer:
         self.policy = make_policy(
             pcfg.policy, dtype=pcfg.policy_dtype, **dict(pcfg.policy_kwargs)
         )
-        self.optimizer = optax.chain(
-            optax.clip_by_global_norm(pcfg.max_grad_norm),
-            optax.adam(pcfg.lr),
-        )
+        self.optimizer = self._make_optimizer()
 
         cfg, params, data = env.cfg, env.params, env.data
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
@@ -111,13 +108,25 @@ class PPOTrainer:
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
 
     # ------------------------------------------------------------------
+    def _make_optimizer(self):
+        return optax.chain(
+            optax.clip_by_global_norm(self.pcfg.max_grad_norm),
+            optax.adam(self.pcfg.lr),
+        )
+
     def _encode(self, obs: Dict[str, Any]):
         if self._is_transformer:
             return tokens_from_obs(obs, self._window)
         return flatten_obs(obs)
 
     def init_state(self, seed: int = 0) -> TrainState:
-        rng = jax.random.PRNGKey(seed)
+        state = self.init_state_from_key(jax.random.PRNGKey(seed))
+        if self.mesh is not None:
+            state = self._shard_state(state)
+        return state
+
+    def init_state_from_key(self, rng) -> TrainState:
+        """Key-based init (traceable — PBT vmaps this over a population)."""
         rng, k_init = jax.random.split(rng)
         carry0 = self.policy.initial_carry(())
         if self._is_transformer:
@@ -136,10 +145,7 @@ class PPOTrainer:
         pcarry = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (n, *x.shape)), carry0
         )
-        state = TrainState(p, opt_state, env_states, obs_vec, pcarry, rng)
-        if self.mesh is not None:
-            state = self._shard_state(state)
-        return state
+        return TrainState(p, opt_state, env_states, obs_vec, pcarry, rng)
 
     def _shard_state(self, state: TrainState) -> TrainState:
         """Replicate params/opt, shard the env batch over the 'data' axis,
@@ -423,7 +429,15 @@ def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     ckpt_dir = config.get("checkpoint_dir")
     if not ckpt_dir:
         raise ValueError("driver_mode=policy requires checkpoint_dir")
-    from gymfx_tpu.train.checkpoint import load_checkpoint
+    from gymfx_tpu.train.checkpoint import load_checkpoint, read_metadata
+
+    # the checkpoint records which policy architecture produced it; honor
+    # that unless the user explicitly overrides --policy
+    meta = read_metadata(str(ckpt_dir))
+    config = dict(config)
+    if not config.get("policy") and meta.get("policy"):
+        config["policy"] = meta["policy"]
+        config.setdefault("policy_kwargs", meta.get("policy_kwargs") or {})
 
     env = Environment(config)
     trainer = PPOTrainer(env, ppo_config_from(config))
@@ -450,6 +464,10 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     if ckpt_dir:
         from gymfx_tpu.train.checkpoint import save_checkpoint
 
-        save_checkpoint(ckpt_dir, state.params, step=train_metrics["total_env_steps"])
+        save_checkpoint(
+            ckpt_dir, state.params, step=train_metrics["total_env_steps"],
+            metadata={"policy": pcfg.policy,
+                      "policy_kwargs": dict(pcfg.policy_kwargs)},
+        )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

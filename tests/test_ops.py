@@ -1,0 +1,68 @@
+"""Pallas window z-score kernel vs the XLA reference implementation."""
+import numpy as np
+import pytest
+
+from gymfx_tpu.data.feed import _build_feature_tensors
+from gymfx_tpu.ops.window_zscore import (
+    batched_scaled_windows,
+    reference_scaled_windows,
+)
+
+
+def _tensors(n=200, f=3, w=16, sw=64, seed=0):
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame(
+        rng.normal(size=(n, f)) * [1.0, 30.0, 1e-2], columns=list("abc")
+    )
+    return _build_feature_tensors(
+        df, feature_columns=("a", "b", "c"), window_size=w,
+        scaling="rolling_zscore", scaling_window=sw,
+    )
+
+
+def test_kernel_matches_reference_impl():
+    import jax.numpy as jnp
+
+    w = 16
+    padded, mean, std, neutral = _tensors(w=w)
+    steps = jnp.asarray([0, 1, 5, 17, 63, 64, 65, 120, 199, 200], jnp.int32)
+    args = (
+        jnp.asarray(padded), jnp.asarray(mean), jnp.asarray(std),
+        jnp.asarray(neutral), steps,
+    )
+    ours = batched_scaled_windows(*args, window=w, clip=10.0, interpret=True)
+    ref = reference_scaled_windows(*args, window=w, clip=10.0)
+    assert ours.shape == (10, w, 3)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=1e-6)
+
+
+def test_kernel_matches_manual_formula_and_clip():
+    import jax.numpy as jnp
+
+    w = 8
+    padded, mean, std, neutral = _tensors(w=w, sw=32)
+    step = 50
+    out = batched_scaled_windows(
+        jnp.asarray(padded), jnp.asarray(mean), jnp.asarray(std),
+        jnp.asarray(neutral), jnp.asarray([step], jnp.int32),
+        window=w, clip=1.5, interpret=True,
+    )
+    manual = (padded[step:step + w] - mean[step]) / std[step]
+    manual = np.clip(manual, -1.5, 1.5)
+    np.testing.assert_allclose(np.asarray(out[0]), manual, atol=1e-6)
+    assert np.max(np.asarray(out)) <= 1.5
+
+
+def test_neutral_steps_produce_zero_windows():
+    import jax.numpy as jnp
+
+    w = 8
+    padded, mean, std, neutral = _tensors(w=w)
+    out = batched_scaled_windows(
+        jnp.asarray(padded), jnp.asarray(mean), jnp.asarray(std),
+        jnp.asarray(neutral), jnp.asarray([0, 1], jnp.int32),
+        window=w, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(out), 0.0)

@@ -1,0 +1,63 @@
+"""Population-based training: vmapped members, per-member learning
+rates, exploit/explore (new capability — BASELINE.json config 5)."""
+import numpy as np
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+from gymfx_tpu.train.pbt import PBTConfig, PBTTrainer
+from gymfx_tpu.train.ppo import ppo_config_from
+from tests.helpers import uptrend_df
+
+
+def _pbt(**over):
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=4, ppo_horizon=8,
+                  ppo_epochs=1, ppo_minibatches=2,
+                  policy_kwargs={"hidden": [16, 16]})
+    config.update(over)
+    env = Environment(config, dataset=MarketDataset(uptrend_df(80), config))
+    return PBTTrainer(env, ppo_config_from(config),
+                      PBTConfig(population=4, interval=2))
+
+
+def test_population_trains_with_distinct_learning_rates():
+    pbt = _pbt()
+    states, fitness = pbt.init_population(0)
+    lrs = pbt.get_lrs(states)
+    assert len(set(np.round(lrs, 10))) > 1  # log-uniform init differs
+    states, metrics = pbt._vstep(states)
+    assert np.asarray(metrics["loss"]).shape == (4,)
+    assert np.isfinite(np.asarray(metrics["loss"])).all()
+
+
+def test_exploit_explore_copies_top_params_to_bottom():
+    import jax
+
+    pbt = _pbt()
+    states, fitness = pbt.init_population(0)
+    states, _ = pbt._vstep(states)
+    fitness = np.array([0.0, 5.0, 1.0, 2.0])  # member 0 is worst, 1 is best
+    rng = np.random.default_rng(0)
+    new_states, new_fitness, replaced = pbt._exploit_explore(states, fitness, rng)
+    assert replaced == [0]
+    # member 0's params now equal member 1's
+    p0 = jax.tree.map(lambda x: np.asarray(x[0]), new_states.params)
+    p1 = jax.tree.map(lambda x: np.asarray(x[1]), new_states.params)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_array_equal(a, b)
+    assert new_fitness[0] == 5.0
+    # lr perturbed from the donor's, still within bounds
+    lrs = pbt.get_lrs(new_states)
+    assert pbt.pbt.lr_min <= lrs[0] <= pbt.pbt.lr_max
+
+
+def test_full_pbt_train_returns_best_member():
+    pbt = _pbt()
+    result = pbt.train(total_env_steps=4 * 8 * 4 * 6, seed=1)
+    assert result["population"] == 4
+    assert len(result["fitness"]) == 4
+    assert 0 <= result["best_member"] < 4
+    assert result["best_params"] is not None
+    assert np.isfinite(result["fitness"]).all()

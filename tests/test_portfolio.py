@@ -1,0 +1,105 @@
+"""Multi-pair portfolio env: alignment, conversion, netting, margin
+(new capability — BASELINE.json config 5)."""
+import numpy as np
+import pytest
+
+from gymfx_tpu.core.portfolio import PortfolioEnvironment
+
+FILES = {
+    "EUR_USD": "examples/data/eurusd_sample.csv",
+    "GBP_USD": "examples/data/gbpusd_sample.csv",
+    "USD_JPY": "examples/data/usdjpy_sample.csv",
+}
+
+
+def _env(**over):
+    config = {"portfolio_files": FILES, "window_size": 8, "initial_cash": 10000.0}
+    config.update(over)
+    return PortfolioEnvironment(config)
+
+
+def test_loads_and_aligns_three_pairs():
+    env = _env()
+    assert env.cfg.n_pairs == 3
+    assert env.data.n_bars >= 400
+    conv = np.asarray(env.data.conv)
+    # USD-quoted pairs convert 1:1; USD/JPY converts at 1/price
+    np.testing.assert_allclose(conv[:, 0], 1.0)
+    np.testing.assert_allclose(conv[:, 1], 1.0)
+    np.testing.assert_allclose(
+        conv[:, 2], 1.0 / np.asarray(env.data.close)[:, 2], rtol=1e-6
+    )
+
+
+def test_obs_shapes_and_flat_hold():
+    env = _env()
+    state, obs = env.reset()
+    assert obs["prices"].shape == (8, 3)
+    assert obs["position"].shape == (3,)
+    for _ in range(10):
+        state, obs, r, done, info = env.step(state, np.zeros(3, np.int32))
+        assert float(r) == 0.0
+    assert float(info["equity"]) == 10000.0
+
+
+def test_per_pair_entries_and_jpy_conversion():
+    env = _env(portfolio_position_sizes=[1000.0, 1000.0, 1000.0])
+    state, obs = env.reset()
+    # warmup: long EUR, short JPY, hold GBP
+    actions = np.array([1, 0, 2], np.int32)
+    state, *_ = env.step(state, actions)
+    state, obs, r, done, info = env.step(state, np.zeros(3, np.int32))
+    positions = np.asarray(info["positions"])
+    assert positions.tolist() == [1, 0, -1]
+    # equity delta equals the converted mark-to-market of both legs
+    opens = np.asarray(env.data.open)
+    closes = np.asarray(env.data.close)
+    conv = np.asarray(env.data.conv)
+    expected = (
+        1000.0 * (closes[1, 0] - opens[1, 0]) * conv[1, 0]
+        + -1000.0 * (closes[1, 2] - opens[1, 2]) * conv[1, 2]
+    )
+    assert float(info["equity_delta"]) == pytest.approx(expected, rel=1e-4, abs=1e-4)
+
+
+def test_flip_counts_trades():
+    env = _env()
+    state, obs = env.reset()
+    state, *_ = env.step(state, np.array([1, 0, 0], np.int32))
+    state, *_ = env.step(state, np.array([2, 0, 0], np.int32))
+    state, obs, r, d, info = env.step(state, np.zeros(3, np.int32))
+    assert int(info["trades"]) == 1
+    assert np.asarray(info["positions"]).tolist() == [-1, 0, 0]
+
+
+def test_action_3_flattens():
+    env = _env()
+    state, obs = env.reset()
+    state, *_ = env.step(state, np.array([1, 1, 1], np.int32))
+    state, *_ = env.step(state, np.zeros(3, np.int32))
+    state, *_ = env.step(state, np.array([3, 3, 3], np.int32))
+    state, obs, r, d, info = env.step(state, np.zeros(3, np.int32))
+    assert np.asarray(info["positions"]).tolist() == [0, 0, 0]
+    assert int(info["trades"]) == 3
+
+
+def test_margin_preflight_blocks_oversized_book():
+    env = _env(margin_rate=0.05, leverage=1.0,
+               portfolio_position_sizes=[1e6, 1e6, 1e6])
+    state, obs = env.reset()
+    state, *_ = env.step(state, np.array([1, 1, 1], np.int32))
+    state, obs, r, d, info = env.step(state, np.zeros(3, np.int32))
+    assert int(info["blocked_margin"]) >= 1
+    assert np.asarray(info["positions"]).tolist() == [0, 0, 0]
+
+
+def test_missing_files_config_rejected():
+    with pytest.raises(ValueError, match="portfolio_files"):
+        PortfolioEnvironment({})
+
+
+def test_cross_pair_rejected():
+    with pytest.raises(ValueError, match="no direct conversion"):
+        PortfolioEnvironment(
+            {"portfolio_files": {"EUR_GBP": "examples/data/eurusd_sample.csv"}}
+        )

@@ -205,3 +205,39 @@ def test_impala_from_config_cli_path(tmp_path):
     ])
     assert "train_metrics" in s and np.isfinite(s["train_metrics"]["loss"])
     assert "total_return" in s
+
+
+def test_impala_train_step_on_mesh():
+    from gymfx_tpu.parallel import make_mesh
+    from gymfx_tpu.train.impala import ImpalaTrainer, impala_config_from
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=16, impala_unroll=8,
+                  policy="lstm", policy_kwargs={"hidden": 128})
+    env = Environment(config, dataset=MarketDataset(uptrend_df(60), config))
+    tr = ImpalaTrainer(env, impala_config_from(config),
+                       mesh=make_mesh({"data": 4, "model": 2}))
+    s = tr.init_state(0)
+    s, m = tr.train_step(s)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_checkpoint_metadata_routes_policy_for_eval(tmp_path):
+    from gymfx_tpu.app.main import main
+
+    # IMPALA trains an LSTM by default; eval must rebuild the same
+    # architecture from the checkpoint metadata without --policy.
+    main([
+        "--mode", "training", "--trainer", "impala",
+        "--input_data_file", "examples/data/eurusd_uptrend.csv",
+        "--num_envs", "4", "--train_total_steps", "128", "--impala_unroll", "16",
+        "--window_size", "8", "--checkpoint_dir", str(tmp_path / "ck"),
+        "--results_file", str(tmp_path / "r1.json"), "--quiet_mode",
+    ])
+    s = main([
+        "--driver_mode", "policy", "--checkpoint_dir", str(tmp_path / "ck"),
+        "--input_data_file", "examples/data/eurusd_uptrend.csv",
+        "--window_size", "8",
+        "--results_file", str(tmp_path / "r2.json"), "--quiet_mode",
+    ])
+    assert "total_return" in s and s["checkpoint_step"] == 128

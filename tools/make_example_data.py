@@ -55,11 +55,31 @@ def make_uptrend(n: int = 500) -> pd.DataFrame:
     )
 
 
+def make_pair(n: int, seed: int, level: float, vol: float) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    ts = pd.date_range("2024-01-01 00:00:00", periods=n, freq="1min")
+    close = np.round(level + np.cumsum(rng.normal(0.0, vol, n)), 5)
+    spread = rng.uniform(vol / 8, vol, n)
+    open_ = np.round(close + rng.normal(0, vol / 2, n), 5)
+    return pd.DataFrame(
+        {
+            "DATE_TIME": ts.strftime("%Y-%m-%d %H:%M:%S"),
+            "OPEN": open_,
+            "HIGH": np.round(np.maximum(open_, close) + spread, 5),
+            "LOW": np.round(np.minimum(open_, close) - spread, 5),
+            "CLOSE": close,
+            "VOLUME": rng.integers(50, 2000, n),
+        }
+    )
+
+
 def main() -> None:
     OUT.mkdir(parents=True, exist_ok=True)
     make_sample().to_csv(OUT / "eurusd_sample.csv", index=False)
     make_uptrend().to_csv(OUT / "eurusd_uptrend.csv", index=False)
-    print(f"wrote {OUT}/eurusd_sample.csv and eurusd_uptrend.csv")
+    make_pair(500, 7, 1.26, 9e-5).to_csv(OUT / "gbpusd_sample.csv", index=False)
+    make_pair(500, 11, 151.4, 1.2e-2).to_csv(OUT / "usdjpy_sample.csv", index=False)
+    print(f"wrote 4 sample CSVs under {OUT}")
 
 
 if __name__ == "__main__":
