@@ -266,12 +266,30 @@ def _build_feature_tensors(
 
 
 def load_dataframe(config: Dict[str, Any]) -> pd.DataFrame:
-    """CSV -> dataframe with datetime index and OHLCV backfill."""
+    """CSV -> dataframe with datetime index and OHLCV backfill.
+
+    Canonical bar files (exactly the DATE_TIME,OHLCV schema) go through
+    the native C++ columnar parser when it is available; anything else —
+    extra feature columns, custom date column, headerless files — takes
+    the pandas path with identical semantics."""
     file_path = config.get("input_data_file")
     if not file_path:
         raise ValueError("config key 'input_data_file' is required")
     headers = bool(config.get("headers", True))
     max_rows = config.get("max_rows")
+
+    if (
+        headers
+        and max_rows is None  # pandas' nrows stops early; native would not
+        and str(config.get("date_column", "DATE_TIME")) == "DATE_TIME"
+        and str(config.get("price_column", "CLOSE")) == "CLOSE"
+    ):
+        from gymfx_tpu.data.native_loader import load_ohlcv_csv
+
+        native = load_ohlcv_csv(str(file_path))
+        if native is not None:
+            return native
+
     df = pd.read_csv(file_path, header=0 if headers else None, nrows=max_rows)
 
     date_col = str(config.get("date_column", "DATE_TIME"))

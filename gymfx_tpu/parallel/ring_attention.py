@@ -1,0 +1,107 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context capability (mandated first-class; the reference's only
+"sequence" dimension is a 32-bar numpy window — SURVEY.md §5.7).  For
+sequences too long for one device, shard the sequence over a 'seq' mesh
+axis and stream key/value blocks around the ring with ``ppermute``
+while accumulating attention with the online-softmax recurrence
+(Liu et al. 2023, blockwise ring attention).  Each device only ever
+holds its own Q block and one K/V block: memory O(S/P), communication
+riding ICI neighbor links, result exact (not approximate).
+
+Layout: (seq, heads, head_dim), sequence sharded over ``axis``.
+Causal masking uses global positions reconstructed from the ring
+rotation, so it is exact across shards.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attention(q, k, v, m, l, acc, scale, mask):
+    """One online-softmax accumulation step.
+
+    q: (Sq, H, D); k/v: (Sk, H, D); m/l: (H, Sq); acc: (Sq, H, D);
+    mask: (Sq, Sk) additive (-inf for masked) or None.
+    """
+    scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    if mask is not None:
+        scores = scores + mask[None, :, :]
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    p_ = jnp.exp(scores - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p_.sum(axis=-1)
+    acc_new = acc * corr.T[..., None] + jnp.einsum("hqk,khd->qhd", p_, v)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q, k, v, *, mesh: Mesh, axis: str = "seq", causal: bool = False
+):
+    """Exact attention with the sequence sharded over ``mesh[axis]``.
+
+    q/k/v: (S, H, D) arrays (global view); returns (S, H, D) with the
+    same sharding.  S must divide evenly by the axis size.
+    """
+    s, h, d = q.shape
+    p = mesh.shape[axis]
+    if s % p != 0:
+        raise ValueError(f"sequence length {s} must divide mesh axis {axis}={p}")
+    sb = s // p
+    scale = 1.0 / (d ** 0.5)
+
+    def shard_fn(q_blk, k_blk, v_blk):
+        my = jax.lax.axis_index(axis)
+
+        def body(i, carry):
+            k_cur, v_cur, m, l, acc = carry
+            # the K/V block currently held originated on shard (my - i) % p
+            src = (my - i) % p
+            if causal:
+                q_pos = my * sb + jnp.arange(sb)
+                k_pos = src * sb + jnp.arange(sb)
+                mask = jnp.where(
+                    q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf
+                )
+            else:
+                mask = None
+            m, l, acc = _block_attention(q_blk, k_cur, v_cur, m, l, acc, scale, mask)
+            perm = [(j, (j + 1) % p) for j in range(p)]
+            k_next = jax.lax.ppermute(k_cur, axis, perm)
+            v_next = jax.lax.ppermute(v_cur, axis, perm)
+            return (k_next, v_next, m, l, acc)
+
+        # mark the accumulators as device-varying over the ring axis so
+        # the fori_loop carry type matches after the first iteration
+        m0 = jax.lax.pcast(
+            jnp.full((h, sb), -jnp.inf, q_blk.dtype), axis, to="varying"
+        )
+        l0 = jax.lax.pcast(jnp.zeros((h, sb), q_blk.dtype), axis, to="varying")
+        acc0 = jnp.zeros_like(q_blk)
+        _, _, m, l, acc = jax.lax.fori_loop(
+            0, p, body, (k_blk, v_blk, m0, l0, acc0)
+        )
+        return acc / jnp.maximum(l, 1e-30).T[..., None]
+
+    spec = P(axis, None, None)
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    return fn(q, k, v)
+
+
+def full_attention(q, k, v, *, causal: bool = False):
+    """Single-device reference implementation (parity oracle)."""
+    s, h, d = q.shape
+    scores = jnp.einsum("qhd,khd->hqk", q, k) / (d ** 0.5)
+    if causal:
+        pos = jnp.arange(s)
+        mask = jnp.where(pos[:, None] >= pos[None, :], 0.0, -jnp.inf)
+        scores = scores + mask[None, :, :]
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", weights, v)

@@ -1,0 +1,48 @@
+"""Ring attention over the virtual 8-device CPU mesh vs full attention."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gymfx_tpu.parallel import make_mesh
+from gymfx_tpu.parallel.ring_attention import full_attention, ring_attention
+
+
+def _qkv(s=64, h=4, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (s, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(causal):
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv()
+    ours = ring_attention(q, k, v, mesh=mesh, axis="seq", causal=causal)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_on_smaller_axis():
+    mesh = make_mesh({"seq": 4, "data": 2})
+    q, k, v = _qkv(s=32, h=2, d=8, seed=3)
+    ours = ring_attention(q, k, v, mesh=mesh, axis="seq")
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+
+def test_uneven_sequence_rejected():
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv(s=60)
+    with pytest.raises(ValueError, match="divide"):
+        ring_attention(q, k, v, mesh=mesh)
+
+
+def test_output_is_sequence_sharded():
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv()
+    out = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, mesh=mesh, axis="seq")
+    )(q, k, v)
+    # executes under jit and keeps the (seq,) sharding layout
+    assert out.shape == (64, 4, 16)

@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Build the native C++ components (g++ -O3 -shared)."""
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+NATIVE = REPO / "gymfx_tpu" / "native"
+
+
+def build(force: bool = False) -> pathlib.Path:
+    """Rebuild when the source is newer; safe under concurrent callers
+    (exclusive lock + atomic rename)."""
+    import fcntl
+    import os
+
+    src = NATIVE / "csv_loader.cpp"
+    out = NATIVE / "libgymfx_csv.so"
+    lock = NATIVE / ".build.lock"
+    with open(lock, "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        if out.exists() and not force and out.stat().st_mtime >= src.stat().st_mtime:
+            return out
+        tmp = NATIVE / f".libgymfx_csv.{os.getpid()}.so"
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            str(src), "-o", str(tmp),
+        ]
+        try:
+            subprocess.run(cmd, check=True)
+            os.replace(tmp, out)
+        finally:
+            tmp.unlink(missing_ok=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(f"built {path}")
