@@ -17,7 +17,7 @@ import time
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--n_envs", type=int, default=4096)
+    ap.add_argument("--n_envs", type=int, default=8192)
     ap.add_argument("--horizon", type=int, default=64)
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--quick", action="store_true", help="small shapes (CI)")

@@ -162,3 +162,24 @@ def test_cross_process_determinism():
         hashes = pool.map(_worker_hash, range(2))
     assert len(set(hashes)) == 1
     assert hashes[0] == _worker_hash(0)
+
+
+def test_execution_report_export():
+    from gymfx_tpu.simulation.reports import export_execution_reports
+
+    instruments, profile, result = _run()
+    reports = export_execution_reports(result, instruments, profile)
+    assert len(reports) == 6
+    r = reports[0]
+    for key in ("object_id", "as_of", "producer", "trace_id", "order_intent_id",
+                "state", "requested_units", "filled_units", "requested_price",
+                "filled_price", "spread_cost", "slippage_cost", "commission",
+                "financing", "conversion_cost", "broker_ids", "latency_ms"):
+        assert key in r, key
+    assert r["state"] == "filled"
+    assert r["trace_id"] == result["result_hash"]
+    # JPY fills convert their costs to the account currency
+    jpy = [x for x in reports if x["broker_ids"]["instrument_id"] == "USD/JPY.SIM"]
+    assert jpy and all(x["broker_ids"]["cost_currency"] == "USD" for x in jpy)
+    import json
+    json.dumps(reports)  # fully serializable

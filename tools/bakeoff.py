@@ -35,6 +35,9 @@ def main() -> int:
 
     result = results[0]
     oracle = reconcile_fills(result, instruments, profile, initial_cash=initial)
+    from gymfx_tpu.simulation.reports import export_execution_reports
+
+    reports = export_execution_reports(result, instruments, profile)
     native_final = float(result["summary"]["final_balance"])
     divergence = abs(native_final - oracle["expected_final_balance"])
     evidence = {
@@ -50,6 +53,7 @@ def main() -> int:
         "oracle_expected_final_balance": oracle["expected_final_balance"],
         "divergence": divergence,
         "oracle": oracle,
+        "execution_reports": reports,
     }
     out = REPO / "examples" / "results" / "bakeoff_evidence.json"
     out.parent.mkdir(parents=True, exist_ok=True)
