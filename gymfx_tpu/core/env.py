@@ -32,6 +32,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from gymfx_tpu.core import broker, rewards, strategy
@@ -65,7 +66,16 @@ def reset(
     state = initial_state(cfg)
     state = broker.mark_to_market(state, data.close[0], params)
     # both prev and current equity are initial cash at the warmup publish
-    state = state._replace(prev_equity_delta=state.equity_delta)
+    state = state._replace(
+        prev_equity_delta=state.equity_delta,
+        # obs windows at bar_index=1 cover padded rows [1, 1+w)
+        price_window=jax.lax.dynamic_slice(
+            data.padded_close, (1,), (cfg.window_size,)
+        ).astype(state.price_window.dtype),
+        feat_window=jax.lax.dynamic_slice(
+            data.padded_features, (1, 0), (cfg.window_size, cfg.n_features)
+        ),
+    )
     return state, build_obs(state, data, cfg, params)
 
 
@@ -122,6 +132,22 @@ def step(
     #    re-marks bar 0, which is a no-op on an untouched ledger)
     st_m = broker.mark_to_market(st, c, params)
     st = _select(advance | (live & ~state.started), st_m, st)
+
+    # streaming obs windows: on advance, shift left and append the new
+    # bar's close / raw feature row (raw row i lives at padded[i + w])
+    if cfg.include_prices:
+        new_price = jnp.concatenate(
+            [st.price_window[1:], c[None].astype(st.price_window.dtype)]
+        )
+        st = st._replace(
+            price_window=jnp.where(advance, new_price, st.price_window)
+        )
+    if cfg.n_features > 0:
+        new_feat_row = data.padded_features[t_new + cfg.window_size]
+        new_feat = jnp.concatenate([st.feat_window[1:], new_feat_row[None, :]])
+        st = st._replace(
+            feat_window=jnp.where(advance, new_feat, st.feat_window)
+        )
 
     st = st._replace(started=state.started | live)
 

@@ -24,7 +24,6 @@ from __future__ import annotations
 from typing import Any, Dict
 
 import jax.numpy as jnp
-from jax import lax
 
 from gymfx_tpu.data.calendar import CALENDAR_FEATURE_KEYS, FORCE_CLOSE_FEATURE_KEYS
 from gymfx_tpu.data.feed import MarketData
@@ -50,11 +49,7 @@ def build_obs(
     obs: Dict[str, Any] = {}
 
     if cfg.n_features > 0:
-        win = lax.dynamic_slice(
-            data.padded_features,
-            (step, jnp.zeros((), dtype=step.dtype)),
-            (w, cfg.n_features),
-        )
+        win = state.feat_window  # streaming carry == padded[step : step+w]
         mean = data.feat_mean[step]
         std = data.feat_std[step]
         neutral = data.feat_neutral[step]
@@ -73,7 +68,7 @@ def build_obs(
     price = data.close[state.t]
     prices = None
     if cfg.include_prices:
-        prices = lax.dynamic_slice(data.padded_close, (step,), (w,))
+        prices = state.price_window  # streaming carry
         returns = prices - jnp.concatenate([prices[:1], prices[:-1]])
         obs["prices"] = prices.astype(jnp.float32)
         obs["returns"] = returns.astype(jnp.float32)

@@ -199,6 +199,13 @@ class EnvState(NamedTuple):
     tr_idx: Any            # i32
     prev_close: Any        # previous bar close (<=0 sentinel: none yet)
 
+    # streaming observation windows.  Kept as carries and updated
+    # incrementally (shift + append) on each bar advance: a vmapped
+    # dynamic_slice gather per step costs ~15x the entire env step on
+    # TPU, while the streaming update is pure vector ops.
+    price_window: Any      # (window_size,) close window ending at the current bar
+    feat_window: Any       # (window_size, n_features) raw feature window
+
     # diagnostics
     exec_diag: Any         # (len(EXEC_DIAG_KEYS),) i32
     action_diag: Any       # (len(ACTION_DIAG_KEYS),) i32
@@ -398,6 +405,8 @@ def initial_state(cfg: EnvConfig) -> EnvState:
         tr_len=zi,
         tr_idx=zi,
         prev_close=jnp.asarray(-1.0, dtype=d),
+        price_window=jnp.zeros((cfg.window_size,), dtype=d),
+        feat_window=jnp.zeros((cfg.window_size, cfg.n_features), dtype=jnp.float32),
         exec_diag=jnp.zeros((len(EXEC_DIAG_KEYS),), dtype=jnp.int32),
         action_diag=jnp.zeros((len(ACTION_DIAG_KEYS),), dtype=jnp.int32),
         raw_abs_sum=z,
