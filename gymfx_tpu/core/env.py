@@ -79,6 +79,35 @@ def reset(
     return state, build_obs(state, data, cfg, params)
 
 
+def reset_at(
+    cfg: EnvConfig, params: EnvParams, data: MarketData, t0
+) -> Tuple[EnvState, Dict[str, Any]]:
+    """Reset with the episode starting at bar row ``t0`` (traced).
+
+    New capability for training diversity (the reference always starts
+    at bar 1): rollout collectors draw random start offsets so an env
+    batch covers the dataset instead of replaying its head.  Windows
+    are seeded with one dynamic slice — called per reset, never per
+    step, so the streaming-window fast path is unaffected.
+    """
+    t0 = jnp.asarray(t0, jnp.int32)
+    state = initial_state(cfg)
+    state = state._replace(t=t0)
+    state = broker.mark_to_market(state, data.close[t0], params)
+    state = state._replace(
+        prev_equity_delta=state.equity_delta,
+        price_window=jax.lax.dynamic_slice(
+            data.padded_close, (t0 + 1,), (cfg.window_size,)
+        ).astype(state.price_window.dtype),
+        feat_window=jax.lax.dynamic_slice(
+            data.padded_features,
+            (t0 + 1, jnp.zeros((), jnp.int32)),
+            (cfg.window_size, cfg.n_features),
+        ),
+    )
+    return state, build_obs(state, data, cfg, params)
+
+
 def step(
     cfg: EnvConfig,
     params: EnvParams,

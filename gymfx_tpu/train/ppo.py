@@ -105,6 +105,7 @@ class PPOTrainer:
         self._reset_vec = self._encode(reset_obs)
         self.obs_dim = self._reset_vec.shape
 
+        self._random_start = bool(env.config.get("random_episode_start", False))
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
 
     # ------------------------------------------------------------------
@@ -191,9 +192,22 @@ class PPOTrainer:
         vstep = jax.vmap(env_core.step, in_axes=(None, None, None, 0, 0))
         vencode = jax.vmap(self._encode)
         fwd = jax.vmap(self._policy_forward, in_axes=(None, 0, 0))
-        reset_state = self._reset_state
-        reset_vec = self._reset_vec
         carry0 = self.policy.initial_carry(())
+        if self._random_start:
+            # a per-env bank of fresh episodes at random offsets, drawn
+            # once per rollout (per-step random resets would reintroduce
+            # the vmapped window gather the streaming carries eliminated)
+            rng, k0 = jax.random.split(rng)
+            t0s = jax.random.randint(
+                k0, (self.pcfg.n_envs,), 0, max(1, cfg.n_bars - 2)
+            )
+            reset_state, fresh_obs = jax.vmap(
+                env_core.reset_at, in_axes=(None, None, None, 0)
+            )(cfg, eparams, data, t0s)
+            reset_vec = vencode(fresh_obs)
+        else:
+            reset_state = self._reset_state
+            reset_vec = self._reset_vec
 
         def body(carry, _):
             env_states, obs_vec, pcarry, rng = carry
@@ -341,10 +355,14 @@ class PPOTrainer:
     def train_step(self, state: TrainState):
         return self._train_step(state)
 
-    def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0):
+    def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0,
+              initial_params=None):
         """Run PPO for ~total_env_steps; log metrics every ``log_every``
-        iterations when > 0."""
+        iterations when > 0.  ``initial_params`` warm-starts the policy
+        (checkpoint resume)."""
         state = self.init_state(seed)
+        if initial_params is not None:
+            state = state._replace(params=initial_params)
         steps_per_iter = self.pcfg.n_envs * self.pcfg.horizon
         iters = max(1, int(total_env_steps) // steps_per_iter)
         t0 = time.perf_counter()
@@ -455,7 +473,23 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     pcfg = ppo_config_from(config)
     trainer = PPOTrainer(env, pcfg)
     total = int(config.get("train_total_steps", 1_000_000))
-    state, train_metrics = trainer.train(total, seed=int(config.get("seed", 0) or 0))
+    resume_params = None
+    resume_step = 0
+    ckpt_dir = config.get("checkpoint_dir")
+    if ckpt_dir and config.get("resume_training"):
+        from gymfx_tpu.train.checkpoint import load_checkpoint
+
+        try:
+            template = trainer.init_state(0).params
+            resume_params, resume_step = load_checkpoint(
+                str(ckpt_dir), template=template
+            )
+        except FileNotFoundError:
+            resume_params, resume_step = None, 0  # cold start, empty dir
+    state, train_metrics = trainer.train(
+        total, seed=int(config.get("seed", 0) or 0),
+        initial_params=resume_params,
+    )
 
     summary = evaluate(trainer, state.params)
     summary["train_metrics"] = train_metrics
@@ -464,8 +498,11 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     if ckpt_dir:
         from gymfx_tpu.train.checkpoint import save_checkpoint
 
+        # cumulative step count: orbax silently skips saving a step that
+        # already exists, so a resumed run must advance past the loaded step
         save_checkpoint(
-            ckpt_dir, state.params, step=train_metrics["total_env_steps"],
+            ckpt_dir, state.params,
+            step=resume_step + train_metrics["total_env_steps"],
             metadata={"policy": pcfg.policy,
                       "policy_kwargs": dict(pcfg.policy_kwargs)},
         )

@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""Capture a jax.profiler trace of the PPO rollout + update
+(SURVEY.md §5.1: the reference's only profiling is perf_counter
+sampling in its engine benchmark; this emits a full XLA trace viewable
+in TensorBoard / Perfetto).
+
+Usage: python tools/profile_rollout.py [outdir] [n_envs] [horizon]
+"""
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/gymfx_trace"
+    n_envs = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    horizon = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        input_data_file=str(REPO / "examples" / "data" / "eurusd_sample.csv"),
+        num_envs=n_envs, ppo_horizon=horizon, ppo_epochs=1,
+    )
+    env = Environment(config)
+    trainer = PPOTrainer(env, ppo_config_from(config))
+    state = trainer.init_state(0)
+    state, _ = trainer.train_step(state)  # compile outside the trace
+    jax.block_until_ready(state.params)
+
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            state, metrics = trainer.train_step(state)
+        jax.block_until_ready(state.params)
+    print(f"trace written to {outdir} (open with TensorBoard or Perfetto)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
