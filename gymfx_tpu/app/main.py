@@ -102,6 +102,15 @@ def _run_env(config: Dict[str, Any]) -> Dict[str, Any]:
     # plugin defaults re-merge (lowest precedence — reference main.py:44-46)
     config = merge_config(config, _collect_plugin_defaults(config), {}, {}, {}, {})
 
+    # Built-in drivers run as ONE scanned XLA episode instead of a
+    # per-step python loop (each per-step dispatch costs a device round
+    # trip — seconds per episode on a tunneled accelerator).  Identical
+    # broker/reward/diagnostics semantics; set gym_loop=true to force
+    # the step-by-step Gymnasium path (e.g. for custom host drivers).
+    mode = str(config.get("driver_mode", "buy_hold"))
+    if mode in ("buy_hold", "flat", "random", "replay") and not config.get("gym_loop"):
+        return _run_env_scan(config)
+
     env = build_environment(config=config)
     decide = make_cli_driver(config)
     try:
@@ -117,6 +126,74 @@ def _run_env(config: Dict[str, Any]) -> Dict[str, Any]:
         return env.summary()
     finally:
         env.close()
+
+
+def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
+    """One lax.scan episode + host-side summary (same shape as the
+    Gymnasium-loop path; reference summary surface app/env.py:697-716)."""
+    import jax
+
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.core.types import ACTION_DIAG_KEYS, EXEC_DIAG_KEYS
+    from gymfx_tpu.metrics import compute_analyzers, summarize_default, summarize_trading
+
+    env = Environment(config)
+    driver = env.make_driver()
+    steps = int(config.get("steps", 500))
+    seed = int(config.get("seed", 0) or 0)
+    state, out = env.rollout(driver, steps, seed=seed)
+    state, out = jax.device_get((state, out))
+
+    equity = np.asarray(out["equity_delta"], np.float64) + float(
+        config.get("initial_cash", 10000.0)
+    )
+    done = np.asarray(out["done"], bool)
+    n_steps = int(np.argmax(done)) + 1 if done.any() else steps
+    ts = env.dataset.timestamps.iloc[1 : n_steps + 1]
+    analyzers = compute_analyzers(
+        equity=equity, done=done, state=state, timestamps=ts
+    )
+    final_equity = float(equity[n_steps - 1])
+    name = str(config.get("metrics_plugin", "default_metrics"))
+    summarize = {"default_metrics": summarize_default,
+                 "trading_metrics": summarize_trading}.get(name)
+    if summarize is None:  # third-party plugin from the registry
+        from gymfx_tpu.plugins import get_plugin
+
+        summarize = get_plugin("metrics.plugins", name)(config)
+    summary = summarize(
+        initial_cash=float(config.get("initial_cash", 10000.0)),
+        final_equity=final_equity,
+        analyzers=analyzers,
+        config=config,
+    )
+    action_diag = {
+        key: int(state.action_diag[i]) for i, key in enumerate(ACTION_DIAG_KEYS)
+    }
+    action_diag["raw_abs_sum"] = float(state.raw_abs_sum)
+    has_steps = action_diag["steps"] > 0
+    action_diag["raw_min"] = float(state.raw_min) if has_steps else None
+    action_diag["raw_max"] = float(state.raw_max) if has_steps else None
+    action_diag["continuous_action_threshold"] = (
+        float(config.get("continuous_action_threshold", 0.33) or 0.33)
+        if str(config.get("action_space_mode", "discrete")) == "continuous"
+        else None
+    )
+    summary["action_diagnostics"] = action_diag
+    summary["execution_diagnostics"] = {
+        key: int(state.exec_diag[i]) for i, key in enumerate(EXEC_DIAG_KEYS)
+    }
+    if "event_context" in out:
+        # event fields of the last executed (pre-termination) step,
+        # matching the Gymnasium-loop path's last-info snapshot
+        last = n_steps - 1
+        summary["event_context_diagnostics"] = {
+            k: np.asarray(v)[last].item()
+            for k, v in out["event_context"].items()
+        }
+    else:
+        summary["event_context_diagnostics"] = {}
+    return summary
 
 
 def main(argv=None) -> Dict[str, Any]:

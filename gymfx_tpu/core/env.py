@@ -63,20 +63,7 @@ def reset(
     cfg: EnvConfig, params: EnvParams, data: MarketData
 ) -> Tuple[EnvState, Dict[str, Any]]:
     """Start an episode; returns (state, obs) at bar_index=1."""
-    state = initial_state(cfg)
-    state = broker.mark_to_market(state, data.close[0], params)
-    # both prev and current equity are initial cash at the warmup publish
-    state = state._replace(
-        prev_equity_delta=state.equity_delta,
-        # obs windows at bar_index=1 cover padded rows [1, 1+w)
-        price_window=jax.lax.dynamic_slice(
-            data.padded_close, (1,), (cfg.window_size,)
-        ).astype(state.price_window.dtype),
-        feat_window=jax.lax.dynamic_slice(
-            data.padded_features, (1, 0), (cfg.window_size, cfg.n_features)
-        ),
-    )
-    return state, build_obs(state, data, cfg, params)
+    return reset_at(cfg, params, data, 0)
 
 
 def reset_at(
@@ -132,7 +119,11 @@ def step(
     a, state, event_info = _event_overlay(state, a, data, cfg, params)
 
     # ---- action diagnostics (post-overlay, reference app/env.py:287) -----
-    state = _record_action(state, raw, a, cfg)
+    # Post-termination steps are complete no-ops (the reference's driver
+    # never steps a finished env, so its quirk of still counting
+    # diagnostics there is unobservable; making them inert keeps the
+    # scanned and step-by-step paths byte-identical).
+    state = _record_action(state, raw, a, cfg, ~was_terminated)
 
     # ---- engine advance ---------------------------------------------------
     live = ~was_terminated
@@ -241,10 +232,11 @@ def _event_overlay(state, a, data: MarketData, cfg: EnvConfig, params: EnvParams
     pos_sign = jnp.sign(state.pos).astype(jnp.int32)
     before = a
 
+    live = ~state.terminated
     if cfg.event_context_execution_overlay:
         diag = state.exec_diag
         diag = diag.at[EXEC_DIAG_INDEX["event_context_no_trade_active_steps"]].add(
-            active.astype(jnp.int32)
+            (active & live).astype(jnp.int32)
         )
         forced_flat = (
             active & jnp.asarray(cfg.event_context_force_flat) & (pos_sign != 0)
@@ -259,13 +251,13 @@ def _event_overlay(state, a, data: MarketData, cfg: EnvConfig, params: EnvParams
         after = jnp.where(forced_flat, 3, jnp.where(blocked, 0, before))
         overridden = after != before
         diag = diag.at[EXEC_DIAG_INDEX["event_context_action_overrides"]].add(
-            overridden.astype(jnp.int32)
+            (overridden & live).astype(jnp.int32)
         )
         diag = diag.at[EXEC_DIAG_INDEX["event_context_blocked_entries"]].add(
-            blocked.astype(jnp.int32)
+            (blocked & live).astype(jnp.int32)
         )
         diag = diag.at[EXEC_DIAG_INDEX["event_context_forced_flat_actions"]].add(
-            forced_flat.astype(jnp.int32)
+            (forced_flat & live).astype(jnp.int32)
         )
         state = state._replace(exec_diag=diag)
     else:
@@ -291,13 +283,15 @@ def _event_overlay(state, a, data: MarketData, cfg: EnvConfig, params: EnvParams
     return after, state, event_info
 
 
-def _record_action(state: EnvState, raw, a, cfg: EnvConfig) -> EnvState:
-    """Per-episode action counters (reference app/env.py:744-761)."""
+def _record_action(state: EnvState, raw, a, cfg: EnvConfig, live) -> EnvState:
+    """Per-episode action counters (reference app/env.py:744-761);
+    inert when ``live`` is False (post-termination)."""
+    one = live.astype(jnp.int32)
     diag = state.action_diag
-    diag = diag.at[ACTION_DIAG_INDEX["steps"]].add(1)
-    is_long = a == 1
-    is_short = a == 2
-    is_hold = ~is_long & ~is_short
+    diag = diag.at[ACTION_DIAG_INDEX["steps"]].add(one)
+    is_long = (a == 1) & live
+    is_short = (a == 2) & live
+    is_hold = ~is_long & ~is_short & live
     diag = diag.at[ACTION_DIAG_INDEX["long_actions"]].add(is_long.astype(jnp.int32))
     diag = diag.at[ACTION_DIAG_INDEX["short_actions"]].add(is_short.astype(jnp.int32))
     diag = diag.at[ACTION_DIAG_INDEX["non_hold_actions"]].add(
@@ -310,11 +304,13 @@ def _record_action(state: EnvState, raw, a, cfg: EnvConfig) -> EnvState:
         )
     return state._replace(
         action_diag=diag,
-        raw_abs_sum=state.raw_abs_sum + jnp.abs(raw),
-        raw_min=jnp.minimum(state.raw_min, raw),
-        raw_max=jnp.maximum(state.raw_max, raw),
-        last_raw_action=raw,
-        last_coerced_action=a.astype(jnp.int32),
+        raw_abs_sum=state.raw_abs_sum + jnp.where(live, jnp.abs(raw), 0.0),
+        raw_min=jnp.where(live, jnp.minimum(state.raw_min, raw), state.raw_min),
+        raw_max=jnp.where(live, jnp.maximum(state.raw_max, raw), state.raw_max),
+        last_raw_action=jnp.where(live, raw, state.last_raw_action),
+        last_coerced_action=jnp.where(
+            live, a.astype(jnp.int32), state.last_coerced_action
+        ),
     )
 
 

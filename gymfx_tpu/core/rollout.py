@@ -97,6 +97,40 @@ DRIVERS = {
 }
 
 
+def _make_scan_body(cfg, params, data, driver, collect, offset):
+    """The one scan body shared by rollout and rollout_chunked."""
+
+    def body(carry, i):
+        state, obs, rng, dcarry = carry
+        rng, key = jax.random.split(rng)
+        action, dcarry = driver.act(dcarry, obs, offset + i, key)
+        state, obs, reward, done, info = env_core.step(cfg, params, data, state, action)
+        if collect:
+            out = {
+                # equity_delta carries the full precision: adding
+                # initial_cash in f32 quantizes at ~1e-3 on a 10k account,
+                # so metrics must derive equity from the delta in f64.
+                "equity_delta": state.equity_delta,
+                "equity": params.initial_cash + state.equity_delta,
+                "reward": reward,
+                "done": done,
+                "action": jnp.asarray(action, dtype=jnp.int32),
+                "position": jnp.sign(state.pos).astype(jnp.int32),
+                "trade_count": state.trade_count,
+                "bar_index": state.t + 1,
+            }
+            if cfg.event_context_execution_overlay:
+                out["event_context"] = {
+                    k: v for k, v in info.items()
+                    if k.startswith("event_context_")
+                }
+        else:
+            out = {}
+        return (state, obs, rng, dcarry), out
+
+    return body
+
+
 @partial(jax.jit, static_argnames=("cfg", "steps", "driver", "collect"))
 def rollout(
     cfg: EnvConfig,
@@ -121,30 +155,7 @@ def rollout(
     """
     state, obs = env_core.reset(cfg, params, data)
     init_carry = driver.init() if driver_carry is None else driver_carry
-
-    def body(carry, i):
-        state, obs, rng, dcarry = carry
-        rng, key = jax.random.split(rng)
-        action, dcarry = driver.act(dcarry, obs, i, key)
-        state, obs, reward, done, info = env_core.step(cfg, params, data, state, action)
-        if collect:
-            out = {
-                # equity_delta carries the full precision: adding
-                # initial_cash in f32 quantizes at ~1e-3 on a 10k account,
-                # so metrics must derive equity from the delta in f64.
-                "equity_delta": state.equity_delta,
-                "equity": params.initial_cash + state.equity_delta,
-                "reward": reward,
-                "done": done,
-                "action": jnp.asarray(action, dtype=jnp.int32),
-                "position": jnp.sign(state.pos).astype(jnp.int32),
-                "trade_count": state.trade_count,
-                "bar_index": state.t + 1,
-            }
-        else:
-            out = {}
-        return (state, obs, rng, dcarry), out
-
+    body = _make_scan_body(cfg, params, data, driver, collect, 0)
     (state, obs, rng, _), outputs = jax.lax.scan(
         body, (state, obs, rng, init_carry), jnp.arange(steps)
     )
@@ -157,3 +168,60 @@ def episode_step_count(outputs) -> Any:
     return jnp.where(
         jnp.any(done), jnp.argmax(done) + 1, done.shape[-1]
     )
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "chunk", "driver", "collect")
+)
+def _rollout_chunk(
+    cfg, params, data, driver, chunk, state, obs, rng, dcarry, offset,
+    collect=True,
+):
+    """One fixed-size compiled segment of an episode (see rollout_chunked)."""
+    body = _make_scan_body(cfg, params, data, driver, collect, offset)
+    (state, obs, rng, dcarry), outputs = jax.lax.scan(
+        body, (state, obs, rng, dcarry), jnp.arange(chunk)
+    )
+    return state, obs, rng, dcarry, outputs
+
+
+def rollout_chunked(
+    cfg: EnvConfig,
+    params: EnvParams,
+    data: MarketData,
+    driver: Driver,
+    steps: int,
+    rng: Any,
+    collect: bool = True,
+    driver_carry: Any = None,
+    chunk_size: int = 64,
+):
+    """Episode rollout as a host loop over fixed-size compiled segments.
+
+    Behaviorally identical to ``rollout`` (same scan body), but the
+    compiled program length is ``chunk_size`` regardless of ``steps`` —
+    long-episode scans can take minutes to compile on some backends
+    (observed on remote-compiled TPU), and chunking also reuses one
+    executable across every episode length.  At most two compiles per
+    (cfg, driver): the chunk and the final remainder.
+    """
+    state, obs = env_core.reset(cfg, params, data)
+    if steps <= 0:
+        return state, {}
+    dcarry = driver.init() if driver_carry is None else driver_carry
+    pieces = []
+    done_steps = 0
+    while done_steps < steps:
+        this = min(chunk_size, steps - done_steps)
+        state, obs, rng, dcarry, out = _rollout_chunk(
+            cfg, params, data, driver, this, state, obs, rng, dcarry,
+            jnp.asarray(done_steps, jnp.int32), collect,
+        )
+        if collect:
+            pieces.append(out)
+        done_steps += this
+    if collect:
+        outputs = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *pieces)
+    else:
+        outputs = {}
+    return state, outputs

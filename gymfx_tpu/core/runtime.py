@@ -78,8 +78,12 @@ class Environment:
             self.cfg, params or self.params, self.data, state, action
         )
 
-    def rollout(self, driver, steps: int, seed: int = 0, params=None, collect=True):
-        return rollout_mod.rollout(
+    def rollout(self, driver, steps: int, seed: int = 0, params=None,
+                collect=True, chunk_size: int = 64):
+        """Host-level episode rollout (chunked: compile cost independent
+        of episode length).  For rollouts INSIDE jit/vmap use
+        core.rollout.rollout directly."""
+        return rollout_mod.rollout_chunked(
             self.cfg,
             params or self.params,
             self.data,
@@ -87,6 +91,7 @@ class Environment:
             int(steps),
             jax.random.PRNGKey(seed),
             collect=collect,
+            chunk_size=chunk_size,
         )
 
     def make_driver(self, rng: Optional[np.random.Generator] = None):

@@ -403,13 +403,13 @@ def greedy_policy_driver(trainer: PPOTrainer):
 
 def evaluate(trainer: PPOTrainer, params, steps: Optional[int] = None, seed: int = 0):
     """Greedy-policy episode -> reference-style metrics summary."""
-    from gymfx_tpu.core.rollout import rollout
+    from gymfx_tpu.core.rollout import rollout_chunked
     from gymfx_tpu.metrics import compute_analyzers, summarize_trading
 
     env = trainer.env
     steps = int(steps or env.cfg.n_bars - 1)
     driver = greedy_policy_driver(trainer)
-    state, out = rollout(
+    state, out = rollout_chunked(
         env.cfg, env.params, env.data, driver, steps, jax.random.PRNGKey(seed),
         driver_carry=(params, trainer.policy.initial_carry(())),
     )
@@ -480,7 +480,12 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         from gymfx_tpu.train.checkpoint import load_checkpoint
 
         try:
-            template = trainer.init_state(0).params
+            # shape/dtype template only — building a full TrainState
+            # would allocate the whole env batch just to restore params
+            template = jax.eval_shape(
+                lambda k: trainer.init_state_from_key(k).params,
+                jax.random.PRNGKey(0),
+            )
             resume_params, resume_step = load_checkpoint(
                 str(ckpt_dir), template=template
             )

@@ -1,0 +1,115 @@
+"""Gymnasium VectorEnv over the vmapped scan core.
+
+External RL libraries consume batched envs through the
+``gymnasium.vector.VectorEnv`` API; this adapter serves them from ONE
+jitted vmapped step — no subprocesses, no env copies, one device
+program for the whole batch (the reference has no vector env at all;
+its only batching story is "run more processes").
+
+Follows the gymnasium autoreset convention: an env that terminated at
+step t returns its fresh reset observation at step t+1.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+try:
+    import gymnasium as gym
+    from gymnasium.vector import VectorEnv
+    from gymnasium.vector.utils import batch_space
+except ImportError as exc:  # pragma: no cover
+    raise ImportError("gymnasium is required for GymFxVectorEnv") from exc
+
+import jax
+import jax.numpy as jnp
+
+from gymfx_tpu.core import env as env_core
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.gym_env import build_base_observation_space
+from gymfx_tpu.train.common import masked_reset
+
+
+class GymFxVectorEnv(VectorEnv):
+    def __init__(self, config: Dict[str, Any], num_envs: int, dataset=None):
+        self._env = Environment(config, dataset=dataset)
+        cfg = self._env.cfg
+        self.num_envs = int(num_envs)
+
+        self.single_observation_space = build_base_observation_space(
+            self._env.config, window_size=cfg.window_size
+        )
+        if cfg.action_space_mode == "continuous":
+            self.single_action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        else:
+            self.single_action_space = gym.spaces.Discrete(3)
+        self.observation_space = batch_space(
+            self.single_observation_space, self.num_envs
+        )
+        self.action_space = batch_space(self.single_action_space, self.num_envs)
+
+        n = self.num_envs
+        cfg_, params, data = cfg, self._env.params, self._env.data
+        reset_state, _ = env_core.reset(cfg_, params, data)
+        self._fresh_state = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n, *x.shape)), reset_state
+        )
+
+        def _reset_obs(_):
+            _s, o = env_core.reset(cfg_, params, data)
+            return o
+
+        self._vreset_obs = jax.jit(jax.vmap(_reset_obs))
+
+        def _step(states, prev_done, actions):
+            # gymnasium next-step autoreset: an env that terminated last
+            # step consumes THIS step as its reset — it returns the fresh
+            # reset observation with reward 0 and done False, and the
+            # caller's action for it is discarded (it was conditioned on
+            # the previous episode's terminal observation).
+            stepped, obs, reward, done, _info = jax.vmap(
+                env_core.step, in_axes=(None, None, None, 0, 0)
+            )(cfg_, params, data, states, actions)
+            states = masked_reset(prev_done, reset_state, stepped)
+            _s0, reset_obs = env_core.reset(cfg_, params, data)
+            obs = masked_reset(prev_done, reset_obs, obs)
+            reward = jnp.where(prev_done, 0.0, reward)
+            done = jnp.where(prev_done, False, done)
+            return states, obs, reward, done
+
+        self._vstep = jax.jit(_step)
+        self._states = None
+        self._prev_done = None
+
+    # ------------------------------------------------------------------
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        self._states = self._fresh_state
+        self._prev_done = jnp.zeros((self.num_envs,), bool)
+        obs = self._vreset_obs(jnp.arange(self.num_envs))
+        return self._np_obs(obs), {}
+
+    def step(self, actions):
+        if self._states is None:
+            raise RuntimeError("Call reset() before step().")
+        actions = jnp.asarray(np.asarray(actions)).reshape(self.num_envs, -1)[:, 0]
+        self._states, obs, reward, done, = self._vstep(
+            self._states, self._prev_done, actions
+        )
+        self._prev_done = done
+        obs, reward, done = jax.device_get((obs, reward, done))
+        terminations = np.asarray(done, bool)
+        return (
+            self._np_obs(obs),
+            np.asarray(reward, np.float32),
+            terminations,
+            np.zeros(self.num_envs, bool),
+            {},
+        )
+
+    def close_extras(self, **kwargs):
+        self._states = None
+
+    # ------------------------------------------------------------------
+    def _np_obs(self, obs) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v, np.float32) for k, v in obs.items()}

@@ -69,3 +69,44 @@ def test_cli_rejects_bad_mode(tmp_path):
     bad.write_text(json.dumps({"mode": "bogus"}))
     with pytest.raises(ValueError, match="mode must be"):
         main(["--load_config", str(bad), "--quiet_mode"])
+
+
+def test_scan_and_gym_loop_paths_agree(tmp_path):
+    # deterministic drivers must produce identical summaries through the
+    # scanned episode and the step-by-step Gymnasium loop
+    replay = tmp_path / "acts.csv"
+    replay.write_text("action\n" + "\n".join(
+        str(a) for a in [1, 0, 0, 2, 0, 1, 0, 3 % 3, 2, 0] * 3))
+    for driver_args in (
+        ["--driver_mode", "buy_hold"],
+        ["--driver_mode", "replay", "--replay_actions_file", str(replay),
+         "--commission", "0.0001"],
+    ):
+        base = ["--input_data_file", UPTREND, "--steps", "60",
+                "--quiet_mode", "--results_file", str(tmp_path / "r.json"),
+                "--save_config", str(tmp_path / "c.json"), *driver_args]
+        scan = main(base)
+        loop = main(base + ["--gym_loop", "true"])
+        for key in ("final_equity", "total_return", "trades_total",
+                    "max_drawdown_pct", "sharpe_ratio", "sqn"):
+            assert scan[key] == pytest.approx(loop[key], rel=1e-9, abs=1e-12), key
+        assert scan["action_diagnostics"] == loop["action_diagnostics"]
+        assert scan["execution_diagnostics"] == loop["execution_diagnostics"]
+
+
+def test_scan_and_gym_loop_agree_when_episode_ends_early(tmp_path):
+    # dataset shorter than --steps: post-termination scan steps must be
+    # inert so diagnostics match the loop, which stops at done.
+    # (replay, not random: the two paths use different RNG streams)
+    replay = tmp_path / "acts.csv"
+    replay.write_text("action\n" + "\n".join(["1", "0", "2"] * 80))
+    base = ["--input_data_file", SAMPLE, "--max_rows", "60", "--steps", "200",
+            "--driver_mode", "replay", "--replay_actions_file", str(replay),
+            "--quiet_mode",
+            "--results_file", str(tmp_path / "r.json"),
+            "--save_config", str(tmp_path / "c.json")]
+    scan = main(base)
+    loop = main(base + ["--gym_loop", "true"])
+    assert scan["action_diagnostics"] == loop["action_diagnostics"]
+    assert scan["execution_diagnostics"] == loop["execution_diagnostics"]
+    assert scan["final_equity"] == pytest.approx(loop["final_equity"], abs=1e-9)

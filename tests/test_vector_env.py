@@ -1,0 +1,83 @@
+"""Gymnasium VectorEnv adapter: batched API, autoreset convention."""
+import numpy as np
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.data.feed import MarketDataset
+from gymfx_tpu.vector_env import GymFxVectorEnv
+from tests.helpers import uptrend_df
+
+
+def _venv(n=4, bars=80, **over):
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1")
+    config.update(over)
+    return GymFxVectorEnv(config, n, dataset=MarketDataset(uptrend_df(bars), config))
+
+
+def test_spaces_and_reset_shapes():
+    env = _venv()
+    obs, info = env.reset()
+    assert env.observation_space["prices"].shape == (4, 8)
+    assert obs["prices"].shape == (4, 8)
+    assert env.single_action_space.n == 3
+    assert env.observation_space.contains(obs)
+
+
+def test_batched_step_contract():
+    env = _venv()
+    env.reset()
+    obs, rewards, terms, truncs, info = env.step(np.array([1, 0, 2, 0]))
+    assert rewards.shape == (4,)
+    assert terms.shape == (4,) and truncs.shape == (4,)
+    assert obs["position"].shape == (4, 1)
+    # warmup step: no fills yet
+    np.testing.assert_array_equal(obs["position"][:, 0], 0.0)
+    obs, *_ = env.step(np.zeros(4, np.int64))
+    np.testing.assert_array_equal(obs["position"][:, 0], [1, 0, -1, 0])
+
+
+def test_autoreset_convention():
+    env = _venv(bars=12)
+    env.reset()
+    terms = np.zeros(4, bool)
+    for k in range(14):
+        obs, r, terms, tr, _ = env.step(np.zeros(4, np.int64))
+        if terms.any():
+            break
+    assert terms.all()  # all envs exhausted the 12-bar data together
+    # next step must deliver fresh reset observations (bar_index back to 1)
+    obs, r, terms2, *_ = env.step(np.zeros(4, np.int64))
+    assert not terms2.any()
+    assert np.allclose(obs["steps_remaining_norm"], obs["steps_remaining_norm"][0])
+    # a fresh episode has nearly full steps remaining
+    assert float(obs["steps_remaining_norm"][0, 0]) > 0.8
+
+
+def test_random_policy_loop_runs():
+    env = _venv(n=8)
+    obs, _ = env.reset()
+    rng = np.random.default_rng(0)
+    total = np.zeros(8)
+    for _ in range(30):
+        obs, r, te, tr, _ = env.step(rng.integers(0, 3, 8))
+        total += r
+    assert np.isfinite(total).all()
+
+
+def test_autoreset_discards_stale_action_and_zeroes_reward():
+    env = _venv(bars=12)
+    env.reset()
+    terms = np.zeros(4, bool)
+    while not terms.any():
+        obs, r, terms, *_ = env.step(np.zeros(4, np.int64))
+    # reset step: aggressive actions must be DISCARDED (fresh episode,
+    # no pending order), reward exactly 0, not terminated
+    obs, r, terms2, *_ = env.step(np.array([1, 1, 1, 1]))
+    assert not terms2.any()
+    np.testing.assert_array_equal(r, 0.0)
+    np.testing.assert_array_equal(obs["position"][:, 0], 0.0)
+    # the step AFTER the reset step acts normally (warmup long pending)
+    obs, r, *_ = env.step(np.array([1, 1, 1, 1]))
+    obs, r, *_ = env.step(np.zeros(4, np.int64))
+    np.testing.assert_array_equal(obs["position"][:, 0], 1.0)
