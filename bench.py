@@ -11,8 +11,49 @@ Usage: python bench.py [--n_envs N] [--horizon T] [--iters K] [--quick]
 """
 import argparse
 import json
+import os
+import signal
 import sys
 import time
+
+# Honor JAX_PLATFORMS=cpu even where sitecustomize force-registers a
+# remote accelerator plugin that overrides the env var.
+if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+
+class _Watchdog:
+    """Emit a diagnostic JSON line instead of hanging forever if the
+    accelerator is unreachable (observed: a wedged device tunnel blocks
+    the first device op indefinitely)."""
+
+    def __init__(self, seconds: int = 900):
+        self.seconds = seconds
+
+    def __enter__(self):
+        def on_alarm(signum, frame):
+            print(
+                json.dumps(
+                    {
+                        "metric": "ppo_env_steps_per_sec_per_chip",
+                        "value": 0.0,
+                        "unit": "env steps/sec/chip (BENCH TIMED OUT: "
+                                "accelerator unreachable)",
+                        "vs_baseline": 0.0,
+                    }
+                )
+            )
+            sys.stdout.flush()
+            sys.exit(0)
+
+        signal.signal(signal.SIGALRM, on_alarm)
+        signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        signal.alarm(0)
 
 
 def main() -> None:
@@ -70,4 +111,5 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    with _Watchdog(900):
+        sys.exit(main())

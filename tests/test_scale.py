@@ -1,0 +1,32 @@
+"""Scale sanity: large datasets build and roll out within budget."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core import rollout as R
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+
+
+def test_hundred_k_bar_dataset_builds_and_rolls():
+    n = 100_000
+    rng = np.random.default_rng(0)
+    ts = pd.date_range("2020-01-01", periods=n, freq="1min")
+    close = 1.1 + np.cumsum(rng.normal(0, 5e-5, n))
+    df = pd.DataFrame(
+        {"OPEN": close, "HIGH": close + 1e-4, "LOW": close - 1e-4,
+         "CLOSE": close, "VOLUME": np.ones(n),
+         "f1": rng.normal(size=n)},
+        index=ts,
+    )
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=32, timeframe="M1",
+                  feature_columns=["f1"], include_price_window=True)
+    env = Environment(config, dataset=MarketDataset(df, config))
+    assert env.cfg.n_bars == n
+    # moments precompute covers the full length
+    assert env.data.feat_mean.shape == (n + 1, 1)
+    state, out = env.rollout(R.buy_hold_driver(), steps=500)
+    assert np.isfinite(float(state.equity_delta))
+    assert int(np.asarray(out["bar_index"])[-1]) == 500
