@@ -84,6 +84,10 @@ def run_mode(config: Dict[str, Any]) -> Dict[str, Any]:
             from gymfx_tpu.train.pbt import train_pbt_from_config
 
             return train_pbt_from_config(config)
+        if trainer == "portfolio":
+            from gymfx_tpu.train.portfolio_ppo import train_portfolio_from_config
+
+            return train_portfolio_from_config(config)
         from gymfx_tpu.train.ppo import train_from_config
 
         return train_from_config(config)

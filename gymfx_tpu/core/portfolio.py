@@ -239,7 +239,9 @@ def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
     new_entry = jnp.where(flipping | opening, fill, new_entry)
     new_entry = jnp.where(target == 0, 0.0, new_entry)
     trade_closed = (pos != 0) & ((target == 0) | flipping)
-    trade_count = trade_count + jnp.sum(trade_closed.astype(jnp.int32))
+    # .astype: jnp.sum promotes int32 to int64 under jax_enable_x64,
+    # which breaks the scan-carry dtype contract
+    trade_count = trade_count + jnp.sum(trade_closed.astype(jnp.int32)).astype(jnp.int32)
     pos = target
     entry = new_entry
 
@@ -264,7 +266,7 @@ def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
         blocked = submit & ~margin_ok & (jnp.abs(new_target) > jnp.abs(pos))
         new_target = jnp.where(blocked, pos, new_target)
         submit = submit & ~blocked
-        state_blocked = state.blocked_margin + jnp.sum(blocked.astype(jnp.int32))
+        state_blocked = state.blocked_margin + jnp.sum(blocked.astype(jnp.int32)).astype(jnp.int32)
     else:
         state_blocked = state.blocked_margin
 

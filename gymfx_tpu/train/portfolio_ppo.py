@@ -1,0 +1,342 @@
+"""PPO over the multi-pair portfolio environment (BASELINE config 5:
+multi-pair portfolio, Transformer policy, pod scale).
+
+Differences from the single-pair trainer (train/ppo.py):
+  * actions are per-pair vectors (I,) in {0,1,2,3}\\{3} — the policy
+    emits independent categorical heads, one per instrument, and the
+    joint log-prob is the sum of per-pair log-probs;
+  * observations come from the portfolio obs dict ((window, I) price
+    blocks); the Transformer treats bars as tokens with per-pair
+    channels, the MLP flattens.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gymfx_tpu.core import portfolio as P
+from gymfx_tpu.train.common import masked_reset
+
+
+class PortfolioMLPPolicy(nn.Module):
+    n_pairs: int
+    hidden: Tuple[int, ...] = (256, 256, 256)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for width in self.hidden:
+            x = nn.tanh(nn.Dense(width, dtype=self.dtype)(x))
+        logits = nn.Dense(self.n_pairs * 3, dtype=jnp.float32)(x)
+        value = nn.Dense(1, dtype=jnp.float32)(x)
+        return logits.reshape(*logits.shape[:-1], self.n_pairs, 3), jnp.squeeze(
+            value, -1
+        )
+
+
+class PortfolioTransformerPolicy(nn.Module):
+    """Attention over bars; tokens carry all pairs' features."""
+
+    n_pairs: int
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Dense(self.d_model, dtype=self.dtype)(tokens.astype(self.dtype))
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (tokens.shape[-2], self.d_model), jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.n_layers):
+            y = nn.LayerNorm(dtype=self.dtype)(x)
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.n_heads, dtype=self.dtype
+            )(y, y)
+            x = x + y
+            y = nn.LayerNorm(dtype=self.dtype)(x)
+            y = nn.Dense(self.d_model * 4, dtype=self.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(self.d_model, dtype=self.dtype)(y)
+            x = x + y
+        pooled = jnp.mean(nn.LayerNorm(dtype=self.dtype)(x), axis=-2)
+        logits = nn.Dense(self.n_pairs * 3, dtype=jnp.float32)(pooled)
+        value = nn.Dense(1, dtype=jnp.float32)(pooled)
+        return logits.reshape(*logits.shape[:-1], self.n_pairs, 3), jnp.squeeze(
+            value, -1
+        )
+
+
+class PortfolioPPOConfig(NamedTuple):
+    n_envs: int = 64
+    horizon: int = 64
+    epochs: int = 2
+    minibatches: int = 4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    lr: float = 3e-4
+    ent_coef: float = 0.01
+    vf_coef: float = 0.5
+    max_grad_norm: float = 0.5
+    policy: str = "mlp"  # mlp | transformer
+
+
+class PortfolioTrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    env_states: Any
+    obs_vec: Any
+    rng: Any
+
+
+def _encode_mlp(obs: Dict[str, Any]):
+    return jnp.concatenate(
+        [jnp.ravel(obs[k]).astype(jnp.float32) for k in sorted(obs)], axis=0
+    )
+
+
+def _encode_tokens(obs: Dict[str, Any], window: int):
+    cols = []
+    for k in sorted(obs):
+        v = obs[k]
+        # portfolio window blocks are 2-D (window, I); 1-D blocks are
+        # per-pair/scalar state broadcast along the window (shape tests
+        # alone would misfire when n_pairs == window)
+        if v.ndim >= 2 and v.shape[0] == window:
+            cols.append(v.reshape(window, -1).astype(jnp.float32))
+        else:
+            flat = jnp.ravel(v).astype(jnp.float32)
+            cols.append(jnp.broadcast_to(flat[None, :], (window, flat.shape[0])))
+    return jnp.concatenate(cols, axis=-1)
+
+
+class PortfolioPPOTrainer:
+    def __init__(self, env: P.PortfolioEnvironment, pcfg: PortfolioPPOConfig):
+        self.env = env
+        self.pcfg = pcfg
+        n_pairs = env.cfg.n_pairs
+        if pcfg.policy == "transformer":
+            self.policy = PortfolioTransformerPolicy(n_pairs=n_pairs)
+        elif pcfg.policy == "mlp":
+            self.policy = PortfolioMLPPolicy(n_pairs=n_pairs)
+        else:
+            raise ValueError(
+                f"portfolio trainer supports policy mlp|transformer, "
+                f"got {pcfg.policy!r}"
+            )
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(pcfg.max_grad_norm),
+            optax.adam(pcfg.lr),
+        )
+        self._reset_state, reset_obs = P.reset(env.cfg, env.params, env.data)
+        self._window = env.cfg.window_size
+        self._is_transformer = pcfg.policy == "transformer"
+        self._reset_vec = self._encode(reset_obs)
+        self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+
+    def _encode(self, obs):
+        if self._is_transformer:
+            return _encode_tokens(obs, self._window)
+        return _encode_mlp(obs)
+
+    # ------------------------------------------------------------------
+    def init_state(self, seed: int = 0) -> PortfolioTrainState:
+        rng = jax.random.PRNGKey(seed)
+        rng, k = jax.random.split(rng)
+        params = self.policy.init(k, self._reset_vec)
+        n = self.pcfg.n_envs
+        bcast = lambda x: jnp.broadcast_to(x, (n, *x.shape))  # noqa: E731
+        return PortfolioTrainState(
+            params=params,
+            opt_state=self.optimizer.init(params),
+            env_states=jax.tree.map(bcast, self._reset_state),
+            obs_vec=bcast(self._reset_vec),
+            rng=rng,
+        )
+
+    def _forward(self, params, x):
+        return self.policy.apply(params, x)
+
+    def _rollout(self, params, env_states, obs_vec, rng):
+        cfg, eparams, data = self.env.cfg, self.env.params, self.env.data
+        vstep = jax.vmap(P.step, in_axes=(None, None, None, 0, 0))
+        vencode = jax.vmap(self._encode)
+        fwd = jax.vmap(self._forward, in_axes=(None, 0))
+        reset_state, reset_vec = self._reset_state, self._reset_vec
+
+        def body(carry, _):
+            env_states, obs_vec, rng = carry
+            rng, k = jax.random.split(rng)
+            logits, value = fwd(params, obs_vec)          # (B, I, 3), (B,)
+            actions = jax.random.categorical(k, logits)   # (B, I)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), actions[..., None], axis=-1
+            )[..., 0].sum(axis=-1)                        # joint logp
+            env_states2, obs2, reward, done, _info = vstep(
+                cfg, eparams, data, env_states, actions
+            )
+            obs_vec2 = vencode(obs2)
+            env_states2 = masked_reset(done, reset_state, env_states2)
+            obs_vec2 = masked_reset(done, reset_vec, obs_vec2)
+            out = dict(obs=obs_vec, action=actions, logp=logp, value=value,
+                       reward=reward.astype(jnp.float32), done=done)
+            return (env_states2, obs_vec2, rng), out
+
+        (env_states, obs_vec, rng), traj = jax.lax.scan(
+            body, (env_states, obs_vec, rng), None, length=self.pcfg.horizon
+        )
+        _, bootstrap = jax.vmap(self._forward, in_axes=(None, 0))(params, obs_vec)
+        return env_states, obs_vec, rng, traj, bootstrap
+
+    def _gae(self, traj, last_value):
+        g, lam = self.pcfg.gamma, self.pcfg.gae_lambda
+
+        def body(carry, x):
+            adv_next, v_next = carry
+            reward, value, done = x
+            nonterm = 1.0 - done.astype(jnp.float32)
+            delta = reward + g * v_next * nonterm - value
+            adv = delta + g * lam * nonterm * adv_next
+            return (adv, value), adv
+
+        (_, _), advs = jax.lax.scan(
+            body, (jnp.zeros_like(last_value), last_value),
+            (traj["reward"], traj["value"], traj["done"]), reverse=True,
+        )
+        return advs, advs + traj["value"]
+
+    def _loss(self, params, batch):
+        logits, value = jax.vmap(self._forward, in_axes=(None, 0))(
+            params, batch["obs"]
+        )
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["action"][..., None], axis=-1
+        )[..., 0].sum(axis=-1)
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["adv"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1 - self.pcfg.clip_eps, 1 + self.pcfg.clip_eps) * adv
+        policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+        value_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).sum(axis=-1)
+        )
+        total = (
+            policy_loss + self.pcfg.vf_coef * value_loss
+            - self.pcfg.ent_coef * entropy
+        )
+        return total, dict(policy_loss=policy_loss, value_loss=value_loss,
+                           entropy=entropy)
+
+    def _train_step_impl(self, state: PortfolioTrainState):
+        pcfg = self.pcfg
+        env_states, obs_vec, rng, traj, bootstrap = self._rollout(
+            state.params, state.env_states, state.obs_vec, state.rng
+        )
+        advs, returns = self._gae(traj, bootstrap)
+        n_total = pcfg.horizon * pcfg.n_envs
+        flat = {
+            "obs": traj["obs"].reshape(n_total, *traj["obs"].shape[2:]),
+            "action": traj["action"].reshape(n_total, -1),
+            "logp": traj["logp"].reshape(n_total),
+            "adv": advs.reshape(n_total),
+            "ret": returns.reshape(n_total),
+        }
+        params, opt_state = state.params, state.opt_state
+        mb = n_total // pcfg.minibatches
+
+        def epoch_body(carry, k):
+            params, opt_state = carry
+            perm = jax.random.permutation(k, n_total)
+
+            def mb_body(carry, i):
+                params, opt_state = carry
+                idx = jax.lax.dynamic_slice_in_dim(perm, i * mb, mb)
+                batch = jax.tree.map(lambda x: x[idx], flat)
+                (loss, aux), grads = jax.value_and_grad(
+                    self._loss, has_aux=True
+                )(params, batch)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params
+                )
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, aux)
+
+            (params, opt_state), outs = jax.lax.scan(
+                mb_body, (params, opt_state), jnp.arange(pcfg.minibatches)
+            )
+            return (params, opt_state), outs
+
+        rng, *ks = jax.random.split(rng, pcfg.epochs + 1)
+        (params, opt_state), (losses, auxes) = jax.lax.scan(
+            epoch_body, (params, opt_state), jnp.stack(ks)
+        )
+        metrics = dict(
+            loss=losses.mean(),
+            policy_loss=auxes["policy_loss"].mean(),
+            value_loss=auxes["value_loss"].mean(),
+            entropy=auxes["entropy"].mean(),
+            mean_reward=traj["reward"].mean(),
+        )
+        return PortfolioTrainState(params, opt_state, env_states, obs_vec, rng), metrics
+
+    def train_step(self, state):
+        return self._train_step(state)
+
+    def train(self, total_env_steps: int, seed: int = 0):
+        state = self.init_state(seed)
+        per_iter = self.pcfg.n_envs * self.pcfg.horizon
+        iters = max(1, int(total_env_steps) // per_iter)
+        t0 = time.perf_counter()
+        metrics: Dict[str, Any] = {}
+        for _ in range(iters):
+            state, metrics = self.train_step(state)
+        jax.block_until_ready(state.params)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["env_steps_per_sec"] = per_iter * iters / (time.perf_counter() - t0)
+        out["iterations"] = iters
+        out["total_env_steps"] = per_iter * iters
+        return state, out
+
+
+def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    env = P.PortfolioEnvironment(config)
+    pcfg = PortfolioPPOConfig(
+        n_envs=int(config.get("num_envs", 64) or 64),
+        horizon=int(config.get("ppo_horizon", 64)),
+        epochs=int(config.get("ppo_epochs", 2)),
+        minibatches=int(config.get("ppo_minibatches", 4)),
+        lr=float(config.get("learning_rate", 3e-4)),
+        policy=str(config.get("policy") or "mlp"),
+    )
+    trainer = PortfolioPPOTrainer(env, pcfg)
+    state, metrics = trainer.train(
+        int(config.get("train_total_steps", 1_000_000)),
+        seed=int(config.get("seed", 0) or 0),
+    )
+    summary = {"mode": "training", "trainer": "portfolio_ppo",
+               "pairs": env.pairs, "train_metrics": metrics}
+    ckpt_dir = config.get("checkpoint_dir")
+    if ckpt_dir:
+        from gymfx_tpu.train.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            ckpt_dir, state.params, step=metrics["total_env_steps"],
+            metadata={"policy": f"portfolio_{pcfg.policy}",
+                      "pairs": env.pairs},
+        )
+        summary["checkpoint_dir"] = str(ckpt_dir)
+    return summary

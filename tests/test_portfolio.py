@@ -103,3 +103,47 @@ def test_cross_pair_rejected():
         PortfolioEnvironment(
             {"portfolio_files": {"EUR_GBP": "examples/data/eurusd_sample.csv"}}
         )
+
+
+@pytest.mark.parametrize("policy", ["mlp", "transformer"])
+def test_portfolio_ppo_trains(policy):
+    from gymfx_tpu.train.portfolio_ppo import (
+        PortfolioPPOConfig,
+        PortfolioPPOTrainer,
+    )
+
+    env = _env(window_size=8)
+    pcfg = PortfolioPPOConfig(n_envs=4, horizon=8, epochs=1, minibatches=2,
+                              policy=policy)
+    tr = PortfolioPPOTrainer(env, pcfg)
+    s = tr.init_state(0)
+    s, m = tr.train_step(s)
+    assert np.isfinite(float(m["loss"]))
+    assert np.isfinite(float(m["entropy"]))
+    # per-pair heads: an action batch covers all pairs independently
+    s, m = tr.train_step(s)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_portfolio_cli_training(tmp_path):
+    import json
+
+    from gymfx_tpu.app.main import main
+
+    s = main([
+        "--mode", "training", "--trainer", "portfolio",
+        "--num_envs", "4", "--train_total_steps", "64",
+        "--ppo_horizon", "8", "--window_size", "8",
+        "--results_file", str(tmp_path / "r.json"), "--quiet_mode",
+        "--load_config", str(_write_portfolio_cfg(tmp_path)),
+    ])
+    assert s["trainer"] == "portfolio_ppo"
+    assert len(s["pairs"]) == 3
+
+
+def _write_portfolio_cfg(tmp_path):
+    import json
+
+    p = tmp_path / "pcfg.json"
+    p.write_text(json.dumps({"portfolio_files": FILES}))
+    return p
