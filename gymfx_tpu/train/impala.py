@@ -358,3 +358,4 @@ class _EvalShim:
         self._encode = trainer._encode
         self._policy_forward = trainer._forward
         self._greedy_driver = None
+        self._continuous = False  # IMPALA trains discrete policies

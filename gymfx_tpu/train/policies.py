@@ -149,7 +149,36 @@ def tokens_from_obs(obs: Dict[str, Any], window: int) -> Any:
     return jnp.concatenate(cols, axis=-1)
 
 
+class ContinuousMLPPolicy(nn.Module):
+    """Gaussian actor-critic for action_space_mode=continuous: emits the
+    mean of a Normal over the Box(-1,1,(1,)) action (state-independent
+    learned log-std); the env thresholds the sampled value into
+    hold/long/short (reference app/env.py:343-355)."""
+
+    hidden: Sequence[int] = (256, 256, 256)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for width in self.hidden:
+            x = nn.tanh(nn.Dense(width, dtype=self.dtype)(x))
+        mu = nn.tanh(nn.Dense(1, dtype=jnp.float32)(x))
+        log_std = self.param("log_std", nn.initializers.constant(-0.5), (1,))
+        value = nn.Dense(1, dtype=jnp.float32)(x)
+        return (jnp.squeeze(mu, -1), jnp.broadcast_to(log_std[0], mu.shape[:-1])), jnp.squeeze(value, -1)
+
+    def initial_carry(self, batch_shape=()):
+        return ()
+
+    def apply_seq(self, params, x, carry):
+        dist, value = self.apply(params, x)
+        return dist, value, carry
+
+
 def make_policy(name: str, n_actions: int = 3, dtype: Any = jnp.float32, **kw):
+    if name == "mlp_continuous":
+        return ContinuousMLPPolicy(dtype=dtype, **kw)
     if name == "mlp":
         return MLPPolicy(n_actions=n_actions, dtype=dtype, **kw)
     if name == "lstm":

@@ -77,6 +77,15 @@ def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
     )
 
 
+def _normal_logp(x, mu, log_std):
+    std = jnp.exp(log_std)
+    return (
+        -0.5 * ((x - mu) / std) ** 2
+        - log_std
+        - 0.5 * jnp.log(2.0 * jnp.pi)
+    )
+
+
 class TrainState(NamedTuple):
     params: Any
     opt_state: Any
@@ -93,9 +102,21 @@ class PPOTrainer:
         self.env = env
         self.pcfg = pcfg
         self.mesh = mesh
-        self.policy = make_policy(
-            pcfg.policy, dtype=pcfg.policy_dtype, **dict(pcfg.policy_kwargs)
-        )
+        self._continuous = env.cfg.action_space_mode == "continuous"
+        if self._continuous:
+            if pcfg.policy != "mlp":
+                raise ValueError(
+                    "continuous action mode currently supports the mlp "
+                    f"policy (got {pcfg.policy!r})"
+                )
+            self.policy = make_policy(
+                "mlp_continuous", dtype=pcfg.policy_dtype,
+                **dict(pcfg.policy_kwargs)
+            )
+        else:
+            self.policy = make_policy(
+                pcfg.policy, dtype=pcfg.policy_dtype, **dict(pcfg.policy_kwargs)
+            )
         self.optimizer = self._make_optimizer()
 
         cfg, params, data = env.cfg, env.params, env.data
@@ -209,15 +230,24 @@ class PPOTrainer:
             reset_state = self._reset_state
             reset_vec = self._reset_vec
 
+        continuous = self._continuous
+
         def body(carry, _):
             env_states, obs_vec, pcarry, rng = carry
             rng, k = jax.random.split(rng)
-            logits, value, pcarry2 = fwd(params, obs_vec, pcarry)
-            keys = jax.random.split(k, logits.shape[0])
-            action = jax.vmap(jax.random.categorical)(keys, logits)
-            logp = jnp.take_along_axis(
-                jax.nn.log_softmax(logits), action[:, None], axis=1
-            )[:, 0]
+            dist, value, pcarry2 = fwd(params, obs_vec, pcarry)
+            if continuous:
+                mu, log_std = dist
+                std = jnp.exp(log_std)
+                action = mu + std * jax.random.normal(k, mu.shape)
+                logp = _normal_logp(action, mu, log_std)
+            else:
+                logits = dist
+                keys = jax.random.split(k, logits.shape[0])
+                action = jax.vmap(jax.random.categorical)(keys, logits)
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits), action[:, None], axis=1
+                )[:, 0]
             env_states2, obs2, reward, done, _ = vstep(
                 cfg, eparams, data, env_states, action
             )
@@ -261,11 +291,20 @@ class PPOTrainer:
         return advs, returns
 
     def _loss(self, params, batch):
-        logits, value, _ = jax.vmap(
+        dist, value, _ = jax.vmap(
             self._policy_forward, in_axes=(None, 0, 0)
         )(params, batch["obs"], batch["pcarry"])
-        logp_all = jax.nn.log_softmax(logits)
-        logp = jnp.take_along_axis(logp_all, batch["action"][:, None], axis=1)[:, 0]
+        if self._continuous:
+            mu, log_std = dist
+            logp = _normal_logp(batch["action"], mu, log_std)
+            entropy = jnp.mean(0.5 * jnp.log(2 * jnp.pi * jnp.e) + log_std)
+        else:
+            logits = dist
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["action"][:, None], axis=1
+            )[:, 0]
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
         ratio = jnp.exp(logp - batch["logp"])
         adv = batch["adv"]
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
@@ -273,7 +312,6 @@ class PPOTrainer:
         clipped = jnp.clip(ratio, 1 - self.pcfg.clip_eps, 1 + self.pcfg.clip_eps) * adv
         policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
         value_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
-        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
         total = (
             policy_loss
             + self.pcfg.vf_coef * value_loss
@@ -394,8 +432,11 @@ def greedy_policy_driver(trainer: PPOTrainer):
     def act(carry, obs, i, key):
         params, pcarry = carry
         vec = trainer._encode(obs)
-        logits, _value, pcarry = trainer._policy_forward(params, vec, pcarry)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), (params, pcarry)
+        dist, _value, pcarry = trainer._policy_forward(params, vec, pcarry)
+        if trainer._continuous:
+            mu, _log_std = dist
+            return mu, (params, pcarry)  # deterministic: the mean action
+        return jnp.argmax(dist, axis=-1).astype(jnp.int32), (params, pcarry)
 
     trainer._greedy_driver = Driver(init=lambda: (), act=act)
     return trainer._greedy_driver
