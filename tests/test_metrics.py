@@ -1,0 +1,99 @@
+"""Analyzer equivalents: daily Sharpe grouping, SQN, drawdown surface
+(reference backtrader analyzers wired at app/bt_bridge.py:277-281)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from gymfx_tpu.metrics import (
+    _periodic_returns,
+    compute_analyzers,
+    summarize_default,
+    summarize_trading,
+)
+
+
+class _FakeState:
+    def __init__(self, **kw):
+        self.trade_count = kw.get("trade_count", 0)
+        self.trades_won = kw.get("trades_won", 0)
+        self.trades_lost = kw.get("trades_lost", 0)
+        self.trade_pnl_sum = kw.get("trade_pnl_sum", 0.0)
+        self.trade_pnl_sumsq = kw.get("trade_pnl_sumsq", 0.0)
+        self.max_drawdown_pct = kw.get("max_drawdown_pct", 0.0)
+        self.max_drawdown_money = kw.get("max_drawdown_money", 0.0)
+
+
+def test_daily_grouping_uses_last_equity_of_each_day():
+    # 3 calendar days, intraday noise must not enter the daily returns
+    ts = pd.to_datetime(
+        ["2024-01-01 10:00", "2024-01-01 23:00",
+         "2024-01-02 10:00", "2024-01-02 23:00",
+         "2024-01-03 23:00"]
+    )
+    equity = np.array([10000.0, 10100.0, 9000.0, 10201.0, 10303.01])
+    rets = _periodic_returns(equity, ts)
+    np.testing.assert_allclose(rets, [0.01, 0.01], rtol=1e-12)
+
+
+def test_sharpe_is_rf_adjusted_and_needs_two_returns():
+    ts = pd.to_datetime(["2024-01-01", "2024-01-02", "2024-01-03"])
+    equity = np.array([10000.0, 10100.0, 10201.0])  # +1% daily
+    an = compute_analyzers(
+        equity=equity, done=None, state=_FakeState(), timestamps=ts
+    )
+    # constant 1% daily returns: std ~0 -> sharpe undefined (None)
+    assert an["sharpe"]["sharperatio"] is None
+
+    equity = np.array([10000.0, 10100.0, 10100.0, 10201.0])
+    ts = pd.to_datetime(["2024-01-01", "2024-01-02", "2024-01-03", "2024-01-04"])
+    an = compute_analyzers(
+        equity=equity, done=None, state=_FakeState(), timestamps=ts
+    )
+    daily_rf = 1.01 ** (1 / 252.0) - 1
+    rets = np.array([0.01, 0.0, 0.01]) - daily_rf
+    expected = rets.mean() / rets.std(ddof=1)
+    assert an["sharpe"]["sharperatio"] == pytest.approx(expected, rel=1e-9)
+
+
+def test_sqn_from_trade_moments():
+    # three trades: +10, -5, +7
+    pnls = np.array([10.0, -5.0, 7.0])
+    state = _FakeState(
+        trade_count=3, trades_won=2, trades_lost=1,
+        trade_pnl_sum=pnls.sum(), trade_pnl_sumsq=(pnls**2).sum(),
+    )
+    an = compute_analyzers(equity=np.array([1.0, 2.0]), done=None, state=state)
+    expected = np.sqrt(3) * pnls.mean() / pnls.std(ddof=1)
+    assert an["sqn"]["sqn"] == pytest.approx(expected, rel=1e-9)
+    assert an["trades"]["pnl"]["net"]["average"] == pytest.approx(pnls.mean())
+
+
+def test_done_truncates_equity_stream():
+    equity = np.array([10000.0, 10100.0, 10100.0, 99999.0])
+    done = np.array([False, True, True, True])
+    an = compute_analyzers(
+        equity=equity, done=done, state=_FakeState(),
+        timestamps=pd.to_datetime(
+            ["2024-01-01", "2024-01-02", "2024-01-03", "2024-01-04"]
+        ),
+    )
+    # only the first 2 samples survive -> a single daily return
+    assert len(an["time_return"]) == 1
+
+
+def test_summaries_handle_missing_analyzers():
+    s = summarize_default(
+        initial_cash=10000.0, final_equity=10100.0, analyzers={}, config={}
+    )
+    assert s["total_return"] == pytest.approx(0.01)
+    assert s["sharpe_ratio"] is None and s["sqn"] is None
+    t = summarize_trading(
+        initial_cash=10000.0, final_equity=10100.0, analyzers={}, config={}
+    )
+    assert t["rap"] == pytest.approx(0.01)  # no drawdown info -> no penalty
+    assert "annual_return" not in t
+    t2 = summarize_trading(
+        initial_cash=10000.0, final_equity=10100.0, analyzers={},
+        config={"evaluation_years": 0.5},
+    )
+    assert t2["annual_return"] == pytest.approx(1.01**2 - 1)
