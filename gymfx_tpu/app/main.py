@@ -187,6 +187,20 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
     summary["execution_diagnostics"] = {
         key: int(state.exec_diag[i]) for i, key in enumerate(EXEC_DIAG_KEYS)
     }
+    record_path = config.get("record_actions_file")
+    if record_path:
+        # persist the executed action stream in the replay schema
+        # (driver_mode=replay consumes it — reference
+        # strategy_plugins/default_strategy.py:38-42)
+        import csv
+
+        with open(record_path, "w", encoding="utf-8", newline="") as fh:
+            writer = csv.writer(fh)
+            writer.writerow(["action"])
+            for a in np.asarray(out["action"])[:n_steps]:
+                writer.writerow([int(a)])
+        summary["record_actions_file"] = str(record_path)
+
     if "event_context" in out:
         # event fields of the last executed (pre-termination) step,
         # matching the Gymnasium-loop path's last-info snapshot

@@ -110,3 +110,18 @@ def test_scan_and_gym_loop_agree_when_episode_ends_early(tmp_path):
     assert scan["action_diagnostics"] == loop["action_diagnostics"]
     assert scan["execution_diagnostics"] == loop["execution_diagnostics"]
     assert scan["final_equity"] == pytest.approx(loop["final_equity"], abs=1e-9)
+
+
+def test_record_then_replay_roundtrip(tmp_path):
+    rec = tmp_path / "recorded.csv"
+    s1 = main(["--input_data_file", SAMPLE, "--driver_mode", "random",
+               "--seed", "11", "--steps", "80", "--quiet_mode",
+               "--results_file", str(tmp_path / "r1.json"),
+               "--record_actions_file", str(rec)])
+    assert rec.exists()
+    s2 = main(["--input_data_file", SAMPLE, "--driver_mode", "replay",
+               "--replay_actions_file", str(rec), "--steps", "80",
+               "--quiet_mode", "--results_file", str(tmp_path / "r2.json")])
+    # replaying the recorded stream reproduces the episode exactly
+    assert s2["final_equity"] == pytest.approx(s1["final_equity"], abs=1e-9)
+    assert s2["action_diagnostics"]["long_actions"] == s1["action_diagnostics"]["long_actions"]
