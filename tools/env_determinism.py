@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Scan-engine determinism evidence (SURVEY.md §4 pattern 3): run the
+same seeded episode repeatedly in-process AND across spawned processes,
+hash the full output stream, and assert all hashes agree.  Emits
+schema-versioned evidence JSON."""
+import hashlib
+import json
+import multiprocessing as mp
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def episode_hash(_=None):
+    sys.path.insert(0, str(REPO))
+    import os
+
+    import jax
+
+    # Honor JAX_PLATFORMS=cpu (incl. in spawned workers): sitecustomize
+    # may force-register a remote accelerator that overrides the env var.
+    if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core import rollout as R
+    from gymfx_tpu.core.runtime import Environment
+
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        input_data_file=str(REPO / "examples" / "data" / "eurusd_sample.csv"),
+        strategy_plugin="direct_atr_sltp",
+        commission=2e-5,
+        slippage=1e-5,
+    )
+    env = Environment(config)
+    state, out = env.rollout(R.random_driver(), steps=300, seed=42)
+    h = hashlib.sha256()
+    for key in sorted(out):
+        h.update(key.encode())
+        h.update(np.ascontiguousarray(np.asarray(out[key])).tobytes())
+    h.update(np.asarray(state.equity_delta).tobytes())
+    return "sha256:" + h.hexdigest()
+
+
+def main() -> int:
+    in_process = [episode_hash() for _ in range(3)]
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(2) as pool:
+        cross_process = pool.map(episode_hash, range(2))
+    all_hashes = set(in_process) | set(cross_process)
+    evidence = {
+        "schema": "scan_engine_determinism.v1",
+        "runs_in_process": len(in_process),
+        "runs_cross_process": len(cross_process),
+        "hash": in_process[0],
+        "deterministic": len(all_hashes) == 1,
+    }
+    out = REPO / "examples" / "results" / "scan_determinism.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(evidence, indent=2))
+    print(json.dumps(evidence, indent=2))
+    return 0 if evidence["deterministic"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
