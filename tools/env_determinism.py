@@ -14,15 +14,12 @@ sys.path.insert(0, str(REPO))
 
 
 def episode_hash(_=None):
-    sys.path.insert(0, str(REPO))
-    import os
-
     import jax
 
-    # Honor JAX_PLATFORMS=cpu (incl. in spawned workers): sitecustomize
-    # may force-register a remote accelerator that overrides the env var.
-    if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    # Determinism evidence has no reason to touch an accelerator: pin
+    # CPU unconditionally (also avoids queuing concurrent workers on a
+    # single-tenant tunneled device).  Applies in spawn workers too.
+    jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     from gymfx_tpu.config import DEFAULT_VALUES
@@ -59,6 +56,9 @@ def main() -> int:
         "hash": in_process[0],
         "deterministic": len(all_hashes) == 1,
     }
+    if len(all_hashes) > 1:  # make divergence diagnosable from the artifact
+        evidence["hashes_in_process"] = in_process
+        evidence["hashes_cross_process"] = cross_process
     out = REPO / "examples" / "results" / "scan_determinism.json"
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(evidence, indent=2))
