@@ -52,13 +52,18 @@ class _PBTTrainerCore(PPOTrainer):
 
 
 class PBTTrainer:
+    """PBT over any core trainer exposing ``init_state_from_key``,
+    ``_train_step_impl`` and an inject_hyperparams optimizer (the
+    single-pair PPO core by default; see make_portfolio_pbt)."""
+
     def __init__(
         self,
         env: Environment,
-        pcfg: PPOConfig,
+        pcfg: PPOConfig = None,
         pbt: PBTConfig = PBTConfig(),
+        core=None,
     ):
-        self.trainer = _PBTTrainerCore(env, pcfg)
+        self.trainer = core if core is not None else _PBTTrainerCore(env, pcfg)
         self.pbt = pbt
         self._vstep = jax.jit(jax.vmap(self.trainer._train_step_impl), donate_argnums=0)
         self._vinit = jax.jit(jax.vmap(self.trainer.init_state_from_key))
@@ -154,7 +159,61 @@ class PBTTrainer:
         }
 
 
+class _PBTPortfolioCore:
+    """Portfolio PPO core with the learning rate injected into opt_state
+    (BASELINE config 5: multi-pair + transformer under PBT)."""
+
+    def __new__(cls, env, pcfg):
+        from gymfx_tpu.train.portfolio_ppo import PortfolioPPOTrainer
+
+        class Core(PortfolioPPOTrainer):
+            def _make_optimizer(self):
+                def make(learning_rate):
+                    return optax.chain(
+                        optax.clip_by_global_norm(self.pcfg.max_grad_norm),
+                        optax.adam(learning_rate),
+                    )
+
+                return optax.inject_hyperparams(make)(learning_rate=self.pcfg.lr)
+
+        return Core(env, pcfg)
+
+
+def make_portfolio_pbt(config: Dict[str, Any], pbt: PBTConfig) -> "PBTTrainer":
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+    from gymfx_tpu.train.portfolio_ppo import PortfolioPPOConfig
+
+    env = PortfolioEnvironment(config)
+    pcfg = PortfolioPPOConfig(
+        n_envs=int(config.get("num_envs", 64) or 64),
+        horizon=int(config.get("ppo_horizon", 64)),
+        epochs=int(config.get("ppo_epochs", 2)),
+        minibatches=int(config.get("ppo_minibatches", 4)),
+        lr=float(config.get("learning_rate", 3e-4)),
+        policy=str(config.get("policy") or "mlp"),
+    )
+    return PBTTrainer(env, None, pbt, core=_PBTPortfolioCore(env, pcfg))
+
+
 def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    if config.get("portfolio_files"):
+        pbt = PBTConfig(
+            population=int(config.get("pbt_population", 8)),
+            interval=int(config.get("pbt_interval", 5)),
+            quantile=float(config.get("pbt_quantile", 0.25)),
+            lr_min=float(config.get("pbt_lr_min", 1e-5)),
+            lr_max=float(config.get("pbt_lr_max", 1e-2)),
+            perturb=float(config.get("pbt_perturb", 1.25)),
+            fitness_decay=float(config.get("pbt_fitness_decay", 0.7)),
+        )
+        trainer = make_portfolio_pbt(config, pbt)
+        result = trainer.train(
+            int(config.get("train_total_steps", 1_000_000)),
+            seed=int(config.get("seed", 0) or 0),
+        )
+        result.pop("best_params", None)
+        return {"mode": "training", "trainer": "pbt_portfolio", "pbt": result}
+
     env = Environment(config)
     pcfg = ppo_config_from(config)
     pbt = PBTConfig(

@@ -135,10 +135,7 @@ class PortfolioPPOTrainer:
                 f"portfolio trainer supports policy mlp|transformer, "
                 f"got {pcfg.policy!r}"
             )
-        self.optimizer = optax.chain(
-            optax.clip_by_global_norm(pcfg.max_grad_norm),
-            optax.adam(pcfg.lr),
-        )
+        self.optimizer = self._make_optimizer()
         self._reset_state, reset_obs = P.reset(env.cfg, env.params, env.data)
         self._window = env.cfg.window_size
         self._is_transformer = pcfg.policy == "transformer"
@@ -151,8 +148,16 @@ class PortfolioPPOTrainer:
         return _encode_mlp(obs)
 
     # ------------------------------------------------------------------
+    def _make_optimizer(self):
+        return optax.chain(
+            optax.clip_by_global_norm(self.pcfg.max_grad_norm),
+            optax.adam(self.pcfg.lr),
+        )
+
     def init_state(self, seed: int = 0) -> PortfolioTrainState:
-        rng = jax.random.PRNGKey(seed)
+        return self.init_state_from_key(jax.random.PRNGKey(seed))
+
+    def init_state_from_key(self, rng) -> PortfolioTrainState:
         rng, k = jax.random.split(rng)
         params = self.policy.init(k, self._reset_vec)
         n = self.pcfg.n_envs

@@ -61,3 +61,26 @@ def test_full_pbt_train_returns_best_member():
     assert 0 <= result["best_member"] < 4
     assert result["best_params"] is not None
     assert np.isfinite(result["fitness"]).all()
+
+
+def test_portfolio_pbt_population_trains():
+    from gymfx_tpu.train.pbt import PBTConfig, make_portfolio_pbt
+
+    config = {
+        "portfolio_files": {
+            "EUR_USD": "examples/data/eurusd_sample.csv",
+            "GBP_USD": "examples/data/gbpusd_sample.csv",
+        },
+        "window_size": 8, "num_envs": 4, "ppo_horizon": 8,
+        "ppo_epochs": 1, "ppo_minibatches": 2,
+    }
+    pbt = make_portfolio_pbt(config, PBTConfig(population=3, interval=2))
+    states, fitness = pbt.init_population(0)
+    lrs = pbt.get_lrs(states)
+    assert len(lrs) == 3
+    states, metrics = pbt._vstep(states)
+    assert np.asarray(metrics["loss"]).shape == (3,)
+    assert np.isfinite(np.asarray(metrics["loss"])).all()
+    result = pbt.train(total_env_steps=4 * 8 * 3 * 4, seed=1)
+    assert result["population"] == 3
+    assert np.isfinite(result["fitness"]).all()
