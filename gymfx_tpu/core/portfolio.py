@@ -119,18 +119,31 @@ def build_portfolio_data(
     n = len(next(iter(aligned.values())))
     cols = {k: np.stack([aligned[p][k].to_numpy(np.float64) for p in pairs], 1)
             for k in ("OPEN", "HIGH", "LOW", "CLOSE")}
+    closes = cols["CLOSE"]
+    # quote-currency -> account-currency factors; crosses bridge through
+    # another pair in the book that quotes/bases the account currency
+    parsed = [p.replace("/", "_").split("_", 1) for p in pairs]
     conv = np.ones((n, len(pairs)))
-    for i, pair in enumerate(pairs):
-        base, _, quote = pair.replace("/", "_").partition("_")
+    for i, (base, quote) in enumerate(parsed):
         if quote == account_currency:
             conv[:, i] = 1.0
         elif base == account_currency:
-            conv[:, i] = 1.0 / cols["CLOSE"][:, i]
+            conv[:, i] = 1.0 / closes[:, i]
         else:
-            raise ValueError(
-                f"pair {pair}: no direct conversion from {quote} to "
-                f"{account_currency}; crosses need a bridging pair"
-            )
+            bridge = None
+            for j, (b2, q2) in enumerate(parsed):
+                if b2 == quote and q2 == account_currency:
+                    bridge = closes[:, j]          # quote/ACC price
+                    break
+                if b2 == account_currency and q2 == quote:
+                    bridge = 1.0 / closes[:, j]    # ACC/quote price inverted
+                    break
+            if bridge is None:
+                raise ValueError(
+                    f"pair {pairs[i]}: no direct conversion from {quote} to "
+                    f"{account_currency} and no bridging pair in the book"
+                )
+            conv[:, i] = bridge
     padded = np.concatenate(
         [np.tile(cols["CLOSE"][:1], (window_size, 1)), cols["CLOSE"]], axis=0
     )

@@ -101,7 +101,7 @@ def test_missing_files_config_rejected():
 def test_cross_pair_rejected():
     with pytest.raises(ValueError, match="no direct conversion"):
         PortfolioEnvironment(
-            {"portfolio_files": {"EUR_GBP": "examples/data/eurusd_sample.csv"}}
+            {"portfolio_files": {"AUD_CAD": "examples/data/eurusd_sample.csv"}}
         )
 
 
@@ -147,3 +147,25 @@ def _write_portfolio_cfg(tmp_path):
     p = tmp_path / "pcfg.json"
     p.write_text(json.dumps({"portfolio_files": FILES}))
     return p
+
+
+def test_cross_pair_bridges_through_book():
+    # EUR/GBP (cross) converts GBP pnl to USD through GBP/USD's price
+    files = dict(FILES)
+    files["EUR_GBP"] = "examples/data/eurusd_sample.csv"  # stand-in prices
+    env = _env(portfolio_files=files)
+    assert env.cfg.n_pairs == 4
+    conv = np.asarray(env.data.conv)
+    closes = np.asarray(env.data.close)
+    gbp_usd_idx = env.pairs.index("GBP_USD")
+    eur_gbp_idx = env.pairs.index("EUR_GBP")
+    np.testing.assert_allclose(
+        conv[:, eur_gbp_idx], closes[:, gbp_usd_idx], rtol=1e-6
+    )
+
+
+def test_cross_without_bridge_still_rejected():
+    with pytest.raises(ValueError, match="no bridging pair"):
+        PortfolioEnvironment(
+            {"portfolio_files": {"EUR_GBP": "examples/data/eurusd_sample.csv"}}
+        )
