@@ -148,6 +148,35 @@ def step(
     st = _select(advance, st_b, st)
     # 3. strategy applies the (post-overlay) action at the bar close
     st = strategy.apply_action(st, a, o, h, l, c, mow, cfg, params, act_strategy)
+    # 3b. margin preflight (profile-gated): deny entries whose opening
+    # margin exceeds free cash (reference Nautilus env denial path,
+    # simulation_engines/nautilus_gym.py:162-171; counter kept
+    # engine-neutral as 'preflight_denied')
+    if cfg.enforce_margin_preflight:
+        target = st.pending_target
+        pos_now = st.pos
+        same_sign = pos_now * target > 0
+        # units newly opened: the size increase when flat/adding, the
+        # whole new position on a flip
+        opening = jnp.maximum(jnp.abs(target) - jnp.abs(pos_now), 0.0)
+        opening = jnp.where(
+            (~same_sign) & (target != 0) & (pos_now != 0),
+            jnp.abs(target), opening,
+        )
+        required = opening * c * params.margin_init
+        if cfg.margin_model == "leveraged":
+            required = required / jnp.maximum(params.leverage, 1e-12)
+        free_cash = params.initial_cash + st.cash_delta
+        denied = st.pending_active & (opening > 0) & (required > free_cash)
+        st = st._replace(
+            pending_active=st.pending_active & ~denied,
+            pending_target=jnp.where(denied, pos_now, st.pending_target),
+            pending_sl=jnp.where(denied, 0.0, st.pending_sl),
+            pending_tp=jnp.where(denied, 0.0, st.pending_tp),
+            exec_diag=st.exec_diag.at[EXEC_DIAG_INDEX["preflight_denied"]].add(
+                denied.astype(jnp.int32)
+            ),
+        )
     # 4. mark equity at the close (advancing bars only; the warmup step
     #    re-marks bar 0, which is a no-op on an untouched ledger)
     st_m = broker.mark_to_market(st, c, params)

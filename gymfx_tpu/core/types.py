@@ -41,6 +41,7 @@ EXEC_DIAG_KEYS = (
     "event_context_blocked_entries",
     "event_context_forced_flat_actions",
     "event_context_forced_flat_orders",
+    "preflight_denied",
 )
 EXEC_DIAG_INDEX = {k: i for i, k in enumerate(EXEC_DIAG_KEYS)}
 
@@ -86,6 +87,8 @@ class EnvConfig:
     stage_b_force_close_reward_penalty: bool = False
 
     intrabar_collision_policy: str = "worst_case"  # worst_case | adaptive | ohlc
+    enforce_margin_preflight: bool = False
+    margin_model: str = "leveraged"                # standard | leveraged
 
     dtype: Any = jnp.float32
 
@@ -146,6 +149,9 @@ class EnvParams(NamedTuple):
     # stage-B force-close reward penalty
     force_close_penalty_coef: Any
     force_close_penalty_window_hours: Any
+
+    # margin preflight (instrument initial-margin fraction)
+    margin_init: Any
 
 
 class EnvState(NamedTuple):
@@ -219,6 +225,19 @@ class EnvState(NamedTuple):
 # ---------------------------------------------------------------------------
 # Builders from a merged config dict
 # ---------------------------------------------------------------------------
+def _parse_profile(config: Dict[str, Any]):
+    raw = config.get("execution_cost_profile")
+    if not raw:
+        return None
+    from gymfx_tpu.contracts import ExecutionCostProfile, load_execution_cost_profile
+
+    if isinstance(raw, str):
+        return load_execution_cost_profile(raw)
+    if isinstance(raw, dict):
+        return ExecutionCostProfile.from_dict(raw)
+    return raw
+
+
 def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
                     binary_mask: Tuple[bool, ...] = ()) -> EnvConfig:
     feature_columns = list(config.get("feature_columns") or [])
@@ -230,6 +249,22 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
     dtype = {"float32": jnp.float32, "float64": jnp.float64, "bfloat16": jnp.bfloat16}[
         str(config.get("compute_dtype", "float32"))
     ]
+    profile = _parse_profile(config)
+    collision = str(
+        config.get(
+            "intrabar_collision_policy",
+            profile.intrabar_collision_policy if profile else "worst_case",
+        )
+    )
+    enforce_margin = bool(
+        config.get(
+            "enforce_margin_preflight",
+            profile.enforce_margin_preflight if profile else False,
+        )
+    )
+    margin_model = str(
+        config.get("margin_model", profile.margin_model if profile else "leveraged")
+    )
     return EnvConfig(
         window_size=int(config.get("window_size", 32)),
         n_bars=int(n_bars),
@@ -258,9 +293,9 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         stage_b_force_close_reward_penalty=bool(
             config.get("stage_b_force_close_reward_penalty", False)
         ),
-        intrabar_collision_policy=str(
-            config.get("intrabar_collision_policy", "worst_case")
-        ),
+        intrabar_collision_policy=collision,
+        enforce_margin_preflight=enforce_margin,
+        margin_model=margin_model,
         dtype=dtype,
     )
 
@@ -295,19 +330,8 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig) -> EnvParams:
     # The reference applies profiles only on its Nautilus engine
     # (simulation_engines/nautilus_gym.py:236-238); the scan engine
     # honors them directly.
-    profile_raw = config.get("execution_cost_profile")
-    if profile_raw:
-        from gymfx_tpu.contracts import (
-            ExecutionCostProfile,
-            load_execution_cost_profile,
-        )
-
-        if isinstance(profile_raw, str):
-            profile = load_execution_cost_profile(profile_raw)
-        elif isinstance(profile_raw, dict):
-            profile = ExecutionCostProfile.from_dict(profile_raw)
-        else:
-            profile = profile_raw
+    profile = _parse_profile(config)
+    if profile is not None:
         commission = profile.commission_rate_per_side
         slippage = profile.quote_adverse_rate_per_side
     entry_start_mow = (
@@ -357,6 +381,7 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig) -> EnvParams:
         force_close_penalty_coef=f(
             config.get("force_close_exposure_penalty_coef", 0.0)
         ),
+        margin_init=f(config.get("margin_init", 0.05)),
         force_close_penalty_window_hours=f(
             config.get(
                 "force_close_exposure_penalty_window_hours",

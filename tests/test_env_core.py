@@ -206,3 +206,33 @@ def test_execution_cost_profile_drives_fill_pricing():
     opens = np.asarray(env.data.open)
     fill = opens[1] * (1 + adverse)
     assert float(state.commission_paid) == pytest.approx(0.0001 * fill, rel=1e-5)
+
+
+def test_margin_preflight_denies_undermargined_entries():
+    profile = {
+        "schema_version": "execution_cost_profile.v1",
+        "profile_id": "m", "commission_rate_per_side": 0.0,
+        "full_spread_rate": 0.0, "slippage_bps_per_side": 0.0,
+        "latency_ms": 0, "financing_enabled": False,
+        "intrabar_collision_policy": "worst_case",
+        "limit_fill_policy": "conservative", "margin_model": "standard",
+        "enforce_margin_preflight": True, "random_seed": 0,
+    }
+    # 10M units at ~1.1 with 5% margin needs ~550k >> 10k cash -> denied
+    env = make_env(uptrend_df(), execution_cost_profile=profile,
+                   position_size=10_000_000.0, margin_init=0.05)
+    assert env.cfg.enforce_margin_preflight
+    s, _ = env.reset()
+    s, *_ = env.step(s, 1)
+    s, *_, info = env.step(s, 0)
+    assert int(info["position"]) == 0  # entry never filled
+    assert int(info["execution_diagnostics/preflight_denied"]) == 1
+
+    # an affordable size passes the same gate
+    env2 = make_env(uptrend_df(), execution_cost_profile=profile,
+                    position_size=1000.0, margin_init=0.05)
+    s, _ = env2.reset()
+    s, *_ = env2.step(s, 1)
+    s, *_, info = env2.step(s, 0)
+    assert int(info["position"]) == 1
+    assert int(info["execution_diagnostics/preflight_denied"]) == 0
