@@ -34,6 +34,25 @@ import jax.numpy as jnp
 from gymfx_tpu.core.types import EnvConfig, EnvParams, EnvState
 
 
+def opening_units(pos, target):
+    """Units newly opened by moving ``pos`` -> ``target``: the size
+    increase when flat/adding, the whole new position on a flip.
+    (Single source for preflight and fill decomposition semantics.)"""
+    same_sign = pos * target > 0
+    opening = jnp.maximum(jnp.abs(target) - jnp.abs(pos), 0.0)
+    return jnp.where(
+        (~same_sign) & (target != 0) & (pos != 0), jnp.abs(target), opening
+    )
+
+
+def realized_balance(state: EnvState, params: EnvParams):
+    """Realized-PnL account balance (initial + realized - commissions):
+    cash plus the open position's entry notional — the same measure the
+    replay engine's margin preflight compares against
+    (simulation/replay.py balance semantics)."""
+    return params.initial_cash + state.cash_delta + state.pos * state.entry_price
+
+
 def apply_fill(
     state: EnvState, fill_price, target_units, params: EnvParams
 ) -> EnvState:

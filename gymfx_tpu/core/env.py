@@ -153,24 +153,18 @@ def step(
     # simulation_engines/nautilus_gym.py:162-171; counter kept
     # engine-neutral as 'preflight_denied')
     if cfg.enforce_margin_preflight:
-        target = st.pending_target
-        pos_now = st.pos
-        same_sign = pos_now * target > 0
-        # units newly opened: the size increase when flat/adding, the
-        # whole new position on a flip
-        opening = jnp.maximum(jnp.abs(target) - jnp.abs(pos_now), 0.0)
-        opening = jnp.where(
-            (~same_sign) & (target != 0) & (pos_now != 0),
-            jnp.abs(target), opening,
-        )
+        opening = broker.opening_units(st.pos, st.pending_target)
         required = opening * c * params.margin_init
         if cfg.margin_model == "leveraged":
             required = required / jnp.maximum(params.leverage, 1e-12)
-        free_cash = params.initial_cash + st.cash_delta
-        denied = st.pending_active & (opening > 0) & (required > free_cash)
+        # compare against the realized-balance account (NOT the
+        # full-notional cash ledger, which would mis-gate flips of
+        # leveraged positions) — same measure as the replay engine
+        free = broker.realized_balance(st, params)
+        denied = st.pending_active & (opening > 0) & (required > free)
         st = st._replace(
             pending_active=st.pending_active & ~denied,
-            pending_target=jnp.where(denied, pos_now, st.pending_target),
+            pending_target=jnp.where(denied, 0.0, st.pending_target),
             pending_sl=jnp.where(denied, 0.0, st.pending_sl),
             pending_tp=jnp.where(denied, 0.0, st.pending_tp),
             exec_diag=st.exec_diag.at[EXEC_DIAG_INDEX["preflight_denied"]].add(

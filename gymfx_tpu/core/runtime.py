@@ -37,13 +37,19 @@ class Environment:
         binary_cols = set(config.get("feature_binary_columns") or [])
         binary_mask = tuple(c in binary_cols for c in feature_columns)
 
+        from gymfx_tpu.core.types import _parse_profile
+
+        profile = _parse_profile(self.config)
         self.cfg: EnvConfig = make_env_config(
             self.config,
             n_bars=len(self.dataset),
             n_features=len(feature_columns),
             binary_mask=binary_mask,
+            profile=profile,
         )
-        self.params: EnvParams = make_env_params(self.config, self.cfg)
+        self.params: EnvParams = make_env_params(
+            self.config, self.cfg, profile=profile
+        )
         self.data: MarketData = self.dataset.build_market_data(
             window_size=self.cfg.window_size,
             feature_columns=feature_columns,

@@ -99,6 +99,12 @@ class EnvConfig:
             raise ValueError(f"unknown strategy kernel {self.strategy!r}")
         if self.reward not in ("pnl_reward", "sharpe_reward", "dd_penalized_reward"):
             raise ValueError(f"unknown reward kernel {self.reward!r}")
+        if self.margin_model not in ("standard", "leveraged"):
+            raise ValueError(f"unknown margin_model {self.margin_model!r}")
+        if self.intrabar_collision_policy not in ("worst_case", "adaptive", "ohlc"):
+            raise ValueError(
+                f"unknown intrabar_collision_policy {self.intrabar_collision_policy!r}"
+            )
 
 
 class EnvParams(NamedTuple):
@@ -239,7 +245,7 @@ def _parse_profile(config: Dict[str, Any]):
 
 
 def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
-                    binary_mask: Tuple[bool, ...] = ()) -> EnvConfig:
+                    binary_mask: Tuple[bool, ...] = (), profile=None) -> EnvConfig:
     feature_columns = list(config.get("feature_columns") or [])
     include_prices = bool(config.get("include_price_window", not feature_columns))
     oanda_cal = bool(
@@ -249,7 +255,7 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
     dtype = {"float32": jnp.float32, "float64": jnp.float64, "bfloat16": jnp.bfloat16}[
         str(config.get("compute_dtype", "float32"))
     ]
-    profile = _parse_profile(config)
+    profile = _parse_profile(config) if profile is None else profile
     collision = str(
         config.get(
             "intrabar_collision_policy",
@@ -307,7 +313,7 @@ def _strategy_kernel_name(config: Dict[str, Any]) -> str:
     return "default"
 
 
-def make_env_params(config: Dict[str, Any], cfg: EnvConfig) -> EnvParams:
+def make_env_params(config: Dict[str, Any], cfg: EnvConfig, profile=None) -> EnvParams:
     d = cfg.dtype
     initial_cash = float(config.get("initial_cash", 10000.0))
     min_equity = config.get("min_equity")
@@ -330,7 +336,7 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig) -> EnvParams:
     # The reference applies profiles only on its Nautilus engine
     # (simulation_engines/nautilus_gym.py:236-238); the scan engine
     # honors them directly.
-    profile = _parse_profile(config)
+    profile = _parse_profile(config) if profile is None else profile
     if profile is not None:
         commission = profile.commission_rate_per_side
         slippage = profile.quote_adverse_rate_per_side

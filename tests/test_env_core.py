@@ -236,3 +236,33 @@ def test_margin_preflight_denies_undermargined_entries():
     s, *_, info = env2.step(s, 0)
     assert int(info["position"]) == 1
     assert int(info["execution_diagnostics/preflight_denied"]) == 0
+
+
+def test_margin_preflight_allows_leveraged_flip():
+    # Long 100k units at ~1.1 on 10k cash (leveraged margin): the flip
+    # to short must pass preflight — the realized balance is intact even
+    # though the cash ledger is deeply negative from the open notional.
+    profile = {
+        "schema_version": "execution_cost_profile.v1",
+        "profile_id": "m2", "commission_rate_per_side": 0.0,
+        "full_spread_rate": 0.0, "slippage_bps_per_side": 0.0,
+        "latency_ms": 0, "financing_enabled": False,
+        "intrabar_collision_policy": "worst_case",
+        "limit_fill_policy": "conservative", "margin_model": "leveraged",
+        "enforce_margin_preflight": True, "random_seed": 0,
+    }
+    env = make_env(uptrend_df(), execution_cost_profile=profile,
+                   position_size=100_000.0, margin_init=0.05, leverage=20.0)
+    s, _ = env.reset()
+    s, *_ = env.step(s, 1)          # warmup: long pending
+    s, *_, i1 = env.step(s, 2)      # long fills; flip order placed
+    assert int(i1["position"]) == 1
+    s, *_, i2 = env.step(s, 0)      # flip fills
+    assert int(i2["position"]) == -1
+    assert int(i2["execution_diagnostics/preflight_denied"]) == 0
+
+
+def test_bad_margin_model_rejected():
+    with pytest.raises(ValueError, match="margin_model"):
+        make_env(uptrend_df(), enforce_margin_preflight=True,
+                 margin_model="leverged")
