@@ -145,8 +145,39 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
     driver = env.make_driver()
     steps = int(config.get("steps", 500))
     seed = int(config.get("seed", 0) or 0)
-    state, out = env.rollout(driver, steps, seed=seed)
-    state, out = jax.device_get((state, out))
+    n_envs = int(config.get("num_envs", 1) or 1)
+    batch_stats = None
+    if n_envs > 1:
+        # batch evaluation (new capability): vmap the whole episode over
+        # per-env rng streams and aggregate outcome statistics; the
+        # detailed summary below reports env 0's episode
+        from gymfx_tpu.core.rollout import rollout as rollout_in_jit
+
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_envs)
+
+        def run(key):
+            s, o = rollout_in_jit(
+                env.cfg, env.params, env.data, driver, steps, key
+            )
+            return s, o
+
+        states_b, out_b = jax.jit(jax.vmap(run))(keys)
+        states_b, out_b = jax.device_get((states_b, out_b))
+        finals = np.asarray(out_b["equity_delta"], np.float64)[:, -1]
+        returns = finals / float(config.get("initial_cash", 10000.0))
+        batch_stats = {
+            "num_envs": n_envs,
+            "mean_total_return": float(returns.mean()),
+            "std_total_return": float(returns.std(ddof=1)) if n_envs > 1 else 0.0,
+            "min_total_return": float(returns.min()),
+            "max_total_return": float(returns.max()),
+            "mean_trades": float(np.asarray(states_b.trade_count).mean()),
+        }
+        state = jax.tree.map(lambda x: x[0], states_b)
+        out = jax.tree.map(lambda x: x[0], out_b)
+    else:
+        state, out = env.rollout(driver, steps, seed=seed)
+        state, out = jax.device_get((state, out))
 
     equity = np.asarray(out["equity_delta"], np.float64) + float(
         config.get("initial_cash", 10000.0)
@@ -211,6 +242,8 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
         }
     else:
         summary["event_context_diagnostics"] = {}
+    if batch_stats is not None:
+        summary["batch"] = batch_stats
     return summary
 
 

@@ -1,6 +1,7 @@
 """End-to-end CLI runs (reference app/main.py surface)."""
 import json
 
+import numpy as np
 import pytest
 
 from gymfx_tpu.app.main import main
@@ -125,3 +126,16 @@ def test_record_then_replay_roundtrip(tmp_path):
     # replaying the recorded stream reproduces the episode exactly
     assert s2["final_equity"] == pytest.approx(s1["final_equity"], abs=1e-9)
     assert s2["action_diagnostics"]["long_actions"] == s1["action_diagnostics"]["long_actions"]
+
+
+def test_batch_evaluation_aggregates_over_envs(tmp_path):
+    s = main(["--input_data_file", SAMPLE, "--driver_mode", "random",
+              "--seed", "3", "--steps", "60", "--num_envs", "8",
+              "--quiet_mode", "--results_file", str(tmp_path / "r.json")])
+    b = s["batch"]
+    assert b["num_envs"] == 8
+    assert b["min_total_return"] <= b["mean_total_return"] <= b["max_total_return"]
+    assert np.isfinite(b["std_total_return"])
+    assert b["mean_trades"] >= 0
+    # the detailed summary still reports one episode's metrics
+    assert "final_equity" in s and "action_diagnostics" in s

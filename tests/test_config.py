@@ -76,3 +76,21 @@ def test_compose_config_drops_defaults_and_roundtrips(tmp_path):
     loaded = load_config(str(path))
     assert loaded == json.loads(path.read_text())
     assert loaded["steps"] == 123
+
+
+def test_registry_third_party_registration():
+    from gymfx_tpu.plugins import available, get_plugin, load_plugin, register
+
+    @register("reward.plugins", "my_custom_reward", plugin_params={"alpha": 2.0})
+    def my_custom_reward(config):
+        return {"kernel": "custom"}
+
+    assert "my_custom_reward" in available("reward.plugins")
+    factory, required = load_plugin("reward.plugins", "my_custom_reward")
+    assert required == ["alpha"]
+    assert factory({}) == {"kernel": "custom"}
+    assert get_plugin("reward.plugins", "my_custom_reward") is factory
+    import pytest
+
+    with pytest.raises(ImportError, match="not found"):
+        get_plugin("reward.plugins", "nope")
