@@ -151,24 +151,47 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
         # batch evaluation (new capability): vmap the whole episode over
         # per-env rng streams and aggregate outcome statistics; the
         # detailed summary below reports env 0's episode
-        from gymfx_tpu.core.rollout import rollout as rollout_in_jit
+        # vmap over the CHUNKED host loop so compile cost stays
+        # independent of episode length (long single scans can take
+        # minutes in a remote compiler — see rollout_chunked)
+        import jax.numpy as jnp
+
+        from gymfx_tpu.core import env as env_core
+        from gymfx_tpu.core.rollout import _rollout_chunk
 
         keys = jax.random.split(jax.random.PRNGKey(seed), n_envs)
+        vreset = jax.jit(jax.vmap(
+            lambda _i: env_core.reset(env.cfg, env.params, env.data),
+            in_axes=0,
+        ))
+        states_b, obs_b = vreset(jnp.arange(n_envs))
 
-        def run(key):
-            s, o = rollout_in_jit(
-                env.cfg, env.params, env.data, driver, steps, key
+        def chunk_call(chunk_len, states_b, obs_b, keys_b, offset):
+            f = jax.vmap(
+                lambda st, ob, k: _rollout_chunk(
+                    env.cfg, env.params, env.data, driver, chunk_len,
+                    st, ob, k, (), jnp.asarray(offset, jnp.int32), True,
+                )
             )
-            return s, o
+            return f(states_b, obs_b, keys_b)
 
-        states_b, out_b = jax.jit(jax.vmap(run))(keys)
+        pieces = []
+        done_steps = 0
+        while done_steps < steps:
+            this = min(64, steps - done_steps)
+            states_b, obs_b, keys, _dc, out_piece = chunk_call(
+                this, states_b, obs_b, keys, done_steps
+            )
+            pieces.append(out_piece)
+            done_steps += this
+        out_b = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=1), *pieces)
         states_b, out_b = jax.device_get((states_b, out_b))
         finals = np.asarray(out_b["equity_delta"], np.float64)[:, -1]
         returns = finals / float(config.get("initial_cash", 10000.0))
         batch_stats = {
             "num_envs": n_envs,
             "mean_total_return": float(returns.mean()),
-            "std_total_return": float(returns.std(ddof=1)) if n_envs > 1 else 0.0,
+            "std_total_return": float(returns.std(ddof=1)),
             "min_total_return": float(returns.min()),
             "max_total_return": float(returns.max()),
             "mean_trades": float(np.asarray(states_b.trade_count).mean()),
