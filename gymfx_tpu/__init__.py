@@ -23,3 +23,25 @@ Top-level layout:
 __version__ = "0.1.0"
 
 from gymfx_tpu.config import DEFAULT_VALUES, merge_config  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy convenience exports: top-level names without importing jax
+    # (and transitively initializing a backend) at package import time.
+    if name == "Environment":
+        from gymfx_tpu.core.runtime import Environment
+
+        return Environment
+    if name == "GymFxEnv":
+        from gymfx_tpu.gym_env import GymFxEnv
+
+        return GymFxEnv
+    if name == "GymFxVectorEnv":
+        from gymfx_tpu.vector_env import GymFxVectorEnv
+
+        return GymFxVectorEnv
+    if name == "build_environment":
+        from gymfx_tpu.gym_env import build_environment
+
+        return build_environment
+    raise AttributeError(f"module 'gymfx_tpu' has no attribute {name!r}")

@@ -117,3 +117,43 @@ def test_bracket_audit_trail(tmp_path, monkeypatch):
     assert "long_bracket" in kinds and "short_bracket" in kinds
     long_rec = records[kinds.index("long_bracket")]
     assert long_rec["stop"] < long_rec["entry"] < long_rec["limit"]
+
+
+def test_top_level_exports():
+    import gymfx_tpu
+
+    assert gymfx_tpu.GymFxEnv is GymFxEnv
+    assert gymfx_tpu.build_environment is build_environment
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.vector_env import GymFxVectorEnv
+
+    assert gymfx_tpu.Environment is Environment
+    assert gymfx_tpu.GymFxVectorEnv is GymFxVectorEnv
+    with pytest.raises(AttributeError):
+        gymfx_tpu.nope
+
+
+def test_all_obs_blocks_combined():
+    # features + prices + agent state + stage-B + calendar in one env
+    import numpy as np
+
+    from tests.helpers import make_df
+
+    n = 60
+    rng = np.random.default_rng(0)
+    closes = 1.1 + np.cumsum(rng.normal(0, 1e-4, n))
+    df = make_df(closes, extra={"f1": rng.normal(size=n)})
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1",
+                  feature_columns=["f1"], include_price_window=True,
+                  stage_b_force_close_obs=True, broker_profile="oanda_us_fx")
+    env = GymFxEnv(config, dataset=MarketDataset(df, config))
+    obs, info = env.reset()
+    keys = set(env.observation_space.spaces)
+    assert {"features", "prices", "returns", "position",
+            "bars_to_force_close", "hours_to_fx_daily_break",
+            "margin_available_norm"} <= keys
+    assert env.observation_space.contains(obs)
+    obs, r, d, t, info = env.step(1)
+    assert env.observation_space.contains(obs)
+    assert "is_no_trade_window" in info  # info-only calendar field
