@@ -25,23 +25,24 @@ __version__ = "0.1.0"
 from gymfx_tpu.config import DEFAULT_VALUES, merge_config  # noqa: F401
 
 
+# Lazy convenience exports (PEP 562): top-level names without importing
+# jax (and transitively initializing a backend) at package import time.
+_LAZY = {
+    "Environment": "gymfx_tpu.core.runtime",
+    "GymFxEnv": "gymfx_tpu.gym_env",
+    "GymFxVectorEnv": "gymfx_tpu.vector_env",
+    "build_environment": "gymfx_tpu.gym_env",
+}
+
+
 def __getattr__(name):
-    # Lazy convenience exports: top-level names without importing jax
-    # (and transitively initializing a backend) at package import time.
-    if name == "Environment":
-        from gymfx_tpu.core.runtime import Environment
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module 'gymfx_tpu' has no attribute {name!r}")
+    import importlib
 
-        return Environment
-    if name == "GymFxEnv":
-        from gymfx_tpu.gym_env import GymFxEnv
+    return getattr(importlib.import_module(module), name)
 
-        return GymFxEnv
-    if name == "GymFxVectorEnv":
-        from gymfx_tpu.vector_env import GymFxVectorEnv
 
-        return GymFxVectorEnv
-    if name == "build_environment":
-        from gymfx_tpu.gym_env import build_environment
-
-        return build_environment
-    raise AttributeError(f"module 'gymfx_tpu' has no attribute {name!r}")
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -120,8 +120,22 @@ def test_bracket_audit_trail(tmp_path, monkeypatch):
 
 
 def test_top_level_exports():
+    import subprocess
+    import sys
+
     import gymfx_tpu
 
+    # lazy: importing the package must not pull in the heavy env/adapter
+    # modules (sitecustomize may import jax itself, so check our modules)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, gymfx_tpu; "
+         "assert 'gymfx_tpu.gym_env' not in sys.modules; "
+         "assert 'gymfx_tpu.core.runtime' not in sys.modules; "
+         "assert 'Environment' in dir(gymfx_tpu)"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
     assert gymfx_tpu.GymFxEnv is GymFxEnv
     assert gymfx_tpu.build_environment is build_environment
     from gymfx_tpu.core.runtime import Environment
@@ -135,8 +149,6 @@ def test_top_level_exports():
 
 def test_all_obs_blocks_combined():
     # features + prices + agent state + stage-B + calendar in one env
-    import numpy as np
-
     from tests.helpers import make_df
 
     n = 60
