@@ -4,9 +4,11 @@
 instantiate the six plugin families, run the driver loop, write the
 results JSON, optionally save the non-default config, print the summary.
 
-``mode=training`` additionally routes to the PPO trainer (new
-capability; the reference validates the mode but runs the same episode
-loop for all three).
+New capability beyond the reference (which validates the mode but runs
+the same episode loop for all three): ``mode=training`` routes to the
+PPO / IMPALA / PBT / portfolio trainers, ``mode=optimization`` runs the
+vmapped hyperparameter search, and ``driver_mode=policy`` evaluates a
+checkpointed policy.
 """
 from __future__ import annotations
 

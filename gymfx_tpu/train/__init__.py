@@ -6,4 +6,4 @@ actor-learner is part of the framework: rollout collection is fused
 into the env scan on-device, and gradients all-reduce over the mesh
 (ICI) instead of leaving the chip.
 """
-from gymfx_tpu.train import policies, ppo  # noqa: F401
+from gymfx_tpu.train import impala, pbt, policies, portfolio_ppo, ppo  # noqa: F401
