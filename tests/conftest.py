@@ -22,6 +22,10 @@ import jax  # noqa: E402
 # what actually pins tests to the local virtual-8-device CPU platform.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# Persistent compile cache: the suite compiles hundreds of distinct
+# programs on a 1-core box; caching them across runs cuts minutes.
+jax.config.update("jax_compilation_cache_dir", "/tmp/gymfx_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pathlib  # noqa: E402
 import sys  # noqa: E402
