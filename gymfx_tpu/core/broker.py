@@ -135,9 +135,15 @@ def fill_pending(state: EnvState, open_price, params: EnvParams) -> EnvState:
     """Execute the pending market order at the new bar's open."""
     target = jnp.where(state.pending_active, state.pending_target, state.pos)
     new_state = apply_fill(state, open_price, target, params)
-    entered = state.pending_active & (new_state.pos != 0)
-    # Arm the pending brackets when the entry executed; clear brackets if
-    # the position was closed by this fill.
+    # Re-arm brackets only when the fill actually OPENED units (fresh
+    # entry or flip) — a fill that merely reduces an existing bracketed
+    # position must not overwrite its live brackets with the reduce
+    # order's (zero) SL/TP.
+    entered = (
+        state.pending_active
+        & (new_state.pos != 0)
+        & (opening_units(state.pos, target) > 0)
+    )
     bracket_sl = jnp.where(entered, state.pending_sl, state.bracket_sl)
     bracket_tp = jnp.where(entered, state.pending_tp, state.bracket_tp)
     flat = new_state.pos == 0
@@ -163,19 +169,36 @@ def check_brackets(
     has_sl = sl > 0
     has_tp = tp > 0
 
-    # trigger + raw fill price per side (stop orders gap-fill at open)
+    # trigger + raw fill price per side (stop orders gap-fill at open).
+    # The take-profit (a limit order) honors the profile's
+    # limit_fill_policy (contracts.py _LIMIT_FILL_POLICIES; reference
+    # simulation_engines/contracts.py:101):
+    #   conservative  price must trade THROUGH the limit (strict
+    #                 inequality — an exact touch does not fill, modeling
+    #                 queue position); fills at the limit price exactly;
+    #   touch         an exact touch fills, at the limit price exactly;
+    #   cross         an exact touch fills, and a bar that gaps open
+    #                 beyond the limit fills at the open (price
+    #                 improvement) — the scan engine's no-profile default.
     sl_trig = has_pos & has_sl & jnp.where(long, low <= sl, high >= sl)
-    tp_trig = has_pos & has_tp & jnp.where(long, high >= tp, low <= tp)
+    strict = cfg.limit_fill_policy == "conservative"
+    if strict:
+        tp_trig = has_pos & has_tp & jnp.where(long, high > tp, low < tp)
+    else:
+        tp_trig = has_pos & has_tp & jnp.where(long, high >= tp, low <= tp)
     sl_fill = jnp.where(
         long,
         jnp.where(open_price <= sl, open_price, sl),
         jnp.where(open_price >= sl, open_price, sl),
     )
-    tp_fill = jnp.where(
-        long,
-        jnp.where(open_price >= tp, open_price, tp),
-        jnp.where(open_price <= tp, open_price, tp),
-    )
+    if cfg.limit_fill_policy == "cross":
+        tp_fill = jnp.where(
+            long,
+            jnp.where(open_price >= tp, open_price, tp),
+            jnp.where(open_price <= tp, open_price, tp),
+        )
+    else:  # conservative / touch: a limit never fills better than its price
+        tp_fill = tp
 
     if cfg.intrabar_collision_policy == "ohlc":
         # Walk the O->H->L->C path.  A bar that opens through either
@@ -184,7 +207,14 @@ def check_brackets(
         # With no gap, longs reach TP on the O->H leg before SL on H->L;
         # shorts reach SL (above) on the O->H leg before TP on H->L.
         gap_sl = has_pos & has_sl & jnp.where(long, open_price <= sl, open_price >= sl)
-        gap_tp = has_pos & has_tp & jnp.where(long, open_price >= tp, open_price <= tp)
+        if strict:
+            gap_tp = has_pos & has_tp & jnp.where(
+                long, open_price > tp, open_price < tp
+            )
+        else:
+            gap_tp = has_pos & has_tp & jnp.where(
+                long, open_price >= tp, open_price <= tp
+            )
         exit_sl = gap_sl | (
             sl_trig & ~gap_tp & jnp.where(long, ~tp_trig, jnp.ones_like(gap_sl))
         )

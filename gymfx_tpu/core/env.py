@@ -146,6 +146,19 @@ def step(
     # 2. brackets resolve against the new bar's H/L
     st_b = broker.check_brackets(st, o, h, l, cfg, params)
     st = _select(advance, st_b, st)
+    # 2b. FX rollover financing: the position held at a rollover bar
+    #     (first bar at/after 22:00 UTC of its day) accrues interest from
+    #     the pair's daily rate differential, precomputed into
+    #     data.rollover_accrual (data/financing.py).  One fused
+    #     multiply-add per step — the scan twin of the replay engine's
+    #     apply_rollover (simulation/replay.py) and of the reference's
+    #     FXRolloverInterestModule (reference
+    #     simulation_engines/nautilus_gym.py:276-290).
+    if cfg.financing_enabled:
+        accrual = st.pos * c * data.rollover_accrual[t_new]
+        st = st._replace(
+            cash_delta=st.cash_delta + jnp.where(advance, accrual, 0.0)
+        )
     # 3. strategy applies the (post-overlay) action at the bar close
     st = strategy.apply_action(st, a, o, h, l, c, mow, cfg, params, act_strategy)
     # 3b. margin preflight (profile-gated): deny entries whose opening

@@ -50,6 +50,38 @@ class Environment:
         self.params: EnvParams = make_env_params(
             self.config, self.cfg, profile=profile
         )
+
+        # Honor-or-reject: every profile field must either drive the scan
+        # engine or fail loudly here — a profile must never be silently
+        # degraded (reference wires these through Nautilus' LatencyModel /
+        # FXRolloverInterestModule, simulation_engines/nautilus_gym.py:276-310).
+        if profile is not None and profile.latency_ms > 0:
+            bar_ms = self.dataset.bar_interval_ms()
+            if bar_ms is None:
+                raise ValueError(
+                    "cannot validate latency_ms: the dataset has neither a "
+                    "timeframe label nor enough timestamps to infer the bar "
+                    "interval; set the 'timeframe' config key"
+                )
+            if float(profile.latency_ms) > bar_ms:
+                raise ValueError(
+                    f"latency_ms={profile.latency_ms} exceeds one bar "
+                    f"({bar_ms:.0f} ms): the scan engine's execution model "
+                    "(orders submitted at a bar close fill at the next bar "
+                    "open) subsumes sub-bar latency only; use the replay "
+                    "engine for multi-bar latency"
+                )
+        financing_rate_data = None
+        if self.cfg.financing_enabled:
+            rate_path = self.config.get("financing_rate_data_file")
+            if not rate_path:
+                raise ValueError(
+                    "financing_rate_data_file is required by the selected cost profile"
+                )
+            import pandas as pd
+
+            financing_rate_data = pd.read_csv(rate_path)
+
         self.data: MarketData = self.dataset.build_market_data(
             window_size=self.cfg.window_size,
             feature_columns=feature_columns,
@@ -69,6 +101,8 @@ class Environment:
             force_close_hour=int(config.get("force_close_hour", 20)),
             force_close_window_hours=int(config.get("force_close_window_hours", 4)),
             monday_entry_window_hours=int(config.get("monday_entry_window_hours", 4)),
+            financing_rate_data=financing_rate_data,
+            instrument=str(config.get("instrument", "EUR_USD")),
         )
 
     # ------------------------------------------------------------------

@@ -87,8 +87,12 @@ class EnvConfig:
     stage_b_force_close_reward_penalty: bool = False
 
     intrabar_collision_policy: str = "worst_case"  # worst_case | adaptive | ohlc
+    # "cross" (price-improving gap fills) is the scan engine's historical
+    # no-profile behavior; profiles always set the field explicitly.
+    limit_fill_policy: str = "cross"               # conservative | touch | cross
     enforce_margin_preflight: bool = False
     margin_model: str = "leveraged"                # standard | leveraged
+    financing_enabled: bool = False                # FX rollover interest accrual
 
     dtype: Any = jnp.float32
 
@@ -104,6 +108,10 @@ class EnvConfig:
         if self.intrabar_collision_policy not in ("worst_case", "adaptive", "ohlc"):
             raise ValueError(
                 f"unknown intrabar_collision_policy {self.intrabar_collision_policy!r}"
+            )
+        if self.limit_fill_policy not in ("conservative", "touch", "cross"):
+            raise ValueError(
+                f"unknown limit_fill_policy {self.limit_fill_policy!r}"
             )
 
 
@@ -271,6 +279,27 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
     margin_model = str(
         config.get("margin_model", profile.margin_model if profile else "leveraged")
     )
+    limit_fill = str(
+        config.get(
+            "limit_fill_policy",
+            profile.limit_fill_policy if profile else "cross",
+        )
+    )
+    financing = bool(
+        config.get(
+            "financing_enabled",
+            profile.financing_enabled if profile else False,
+        )
+    )
+    if collision == "adaptive":
+        import warnings
+
+        warnings.warn(
+            "intrabar_collision_policy 'adaptive' resolves to 'worst_case' in "
+            "the scan engine (no per-bar path data to adapt on); see "
+            "DIVERGENCES.md",
+            stacklevel=2,
+        )
     return EnvConfig(
         window_size=int(config.get("window_size", 32)),
         n_bars=int(n_bars),
@@ -300,8 +329,10 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
             config.get("stage_b_force_close_reward_penalty", False)
         ),
         intrabar_collision_policy=collision,
+        limit_fill_policy=limit_fill,
         enforce_margin_preflight=enforce_margin,
         margin_model=margin_model,
+        financing_enabled=financing,
         dtype=dtype,
     )
 

@@ -46,6 +46,8 @@ class MarketData(NamedTuple):
     ev_no_trade: Any   # (n,) float32
     ev_spread_mult: Any  # (n,) float32
     ev_slip_mult: Any  # (n,) float32
+    rollover_accrual: Any  # (n,) compute dtype — daily financing rate on
+                           # rollover bars, 0 elsewhere (data/financing.py)
     padded_features: Any  # (n + window_size, F) float32 (F may be 0)
     feat_mean: Any     # (n + 1, F) float32 — scaler mean fit on strictly-past rows
     feat_std: Any      # (n + 1, F) float32
@@ -106,6 +108,19 @@ class MarketDataset:
     def __len__(self) -> int:
         return len(self.dataframe)
 
+    def bar_interval_ms(self) -> Optional[float]:
+        """Milliseconds per bar: from the timeframe label when present,
+        else the median spacing of valid timestamps; None when neither
+        is available (callers that need it must reject, not guess)."""
+        if self.timeframe_hours:
+            return self.timeframe_hours * 3_600_000.0
+        ts = pd.to_datetime(self.timestamps, errors="coerce").dropna()
+        if len(ts) < 2:
+            return None
+        deltas = ts.diff().dropna().dt.total_seconds()
+        median = float(deltas.median())
+        return median * 1000.0 if median > 0 else None
+
     # ------------------------------------------------------------------
     def build_market_data(
         self,
@@ -122,6 +137,8 @@ class MarketDataset:
         force_close_hour: int = 20,
         force_close_window_hours: int = 4,
         monday_entry_window_hours: int = 4,
+        financing_rate_data: Any = None,
+        instrument: str = "EUR_USD",
     ) -> MarketData:
         df = self.dataframe
         n = len(df)
@@ -163,6 +180,16 @@ class MarketDataset:
         ev_spread = col(event_context_spread_stress_column, 1.0).astype(np.float32)
         ev_slip = col(event_context_slippage_stress_column, 1.0).astype(np.float32)
 
+        if financing_rate_data is not None:
+            from gymfx_tpu.data import financing as fxfin
+
+            base_ccy, quote_ccy = fxfin.split_pair(instrument)
+            accrual = fxfin.precompute_rollover_accrual(
+                self.timestamps, financing_rate_data, base_ccy, quote_ccy
+            )
+        else:
+            accrual = np.zeros(n, dtype=np.float64)
+
         padded_features, feat_mean, feat_std, feat_neutral = _build_feature_tensors(
             df,
             feature_columns=tuple(feature_columns),
@@ -187,6 +214,7 @@ class MarketDataset:
             ev_no_trade=jnp.asarray(ev_no_trade, dtype=f32),
             ev_spread_mult=jnp.asarray(ev_spread, dtype=f32),
             ev_slip_mult=jnp.asarray(ev_slip, dtype=f32),
+            rollover_accrual=jnp.asarray(accrual, dtype=dtype),
             padded_features=jnp.asarray(padded_features, dtype=f32),
             feat_mean=jnp.asarray(feat_mean, dtype=f32),
             feat_std=jnp.asarray(feat_std, dtype=f32),

@@ -1,0 +1,149 @@
+"""FX rollover financing: rate table parsing + per-bar accrual precompute.
+
+The reference accrues rollover interest through NautilusTrader's
+FXRolloverInterestModule, fed by a monthly short-rate CSV with
+LOCATION/TIME/Value rows (reference simulation_engines/nautilus_gym.py:276-290,
+rate schema examples/data/fx_rollover_rates_smoke.csv).  This module is
+the single source of rate semantics for BOTH engines of this framework:
+
+  * the replay engine (simulation/replay.py) looks rates up per event
+    timestamp while walking frames;
+  * the scan engine precomputes ONE accrual-rate column here — zero
+    everywhere except the first bar at/after 22:00 UTC of each calendar
+    day, where it carries the pair's daily rate differential — so the
+    jitted step applies financing as a single fused multiply-add
+    (core/env.py), with no calendar logic in-graph.
+
+Accrual model (matching the replay engine): a position held across the
+22:00 UTC rollover earns/pays  units * mid * (base_rate - quote_rate)
+/ 100 / 365  in quote currency, using the annualized short rates of the
+bar's month (the latest table month at or before the bar; bars before
+the first table month use the earliest entry).
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+import pandas as pd
+
+ROLLOVER_UTC_SECONDS = 22 * 3600  # 17:00 New York standard time
+
+# OECD-style location codes used by the reference's rate fixtures.
+CURRENCY_LOCATION = {"EUR": "EA19", "USD": "USA", "JPY": "JPN", "GBP": "GBR"}
+_LOCATION_CURRENCY = {v: k for k, v in CURRENCY_LOCATION.items()}
+
+RateTable = Dict[str, List[Tuple[int, float]]]
+
+
+def parse_rate_table(rate_data: Any) -> RateTable:
+    """LOCATION/TIME/Value rows -> currency -> sorted [(month_start_ns, pct)].
+
+    ``TIME`` is a month label (YYYY-MM).  Rows with unknown locations or
+    unparseable months are skipped.
+    """
+    if rate_data is None:
+        return {}
+    try:
+        rows = rate_data.to_dict("records")  # pandas DataFrame
+    except AttributeError:
+        rows = list(rate_data)
+    table: RateTable = {}
+    for row in rows:
+        ccy = _LOCATION_CURRENCY.get(str(row.get("LOCATION")))
+        if not ccy:
+            continue
+        ts = pd.to_datetime(str(row.get("TIME")), errors="coerce", utc=True)
+        if ts is pd.NaT:
+            continue
+        table.setdefault(ccy, []).append((int(ts.value), float(row.get("Value", 0.0))))
+    for entries in table.values():
+        entries.sort()
+    return table
+
+
+def rate_at(table: RateTable, currency: str, ts_ns: int) -> float:
+    """Annualized short rate (%) applicable at ``ts_ns``: the latest table
+    month at or before the timestamp; the earliest entry for timestamps
+    before the table starts; 0.0 for unknown currencies."""
+    entries = table.get(currency)
+    if not entries:
+        return 0.0
+    idx = bisect.bisect_right(entries, (int(ts_ns), float("inf"))) - 1
+    return entries[max(idx, 0)][1]
+
+
+def daily_differential(
+    table: RateTable, base_currency: str, quote_currency: str, ts_ns: int
+) -> float:
+    """Per-day accrual rate for one unit-notional of the pair: long base
+    earns the base rate and pays the quote rate (annualized %)."""
+    base = rate_at(table, base_currency, ts_ns)
+    quote = rate_at(table, quote_currency, ts_ns)
+    return (base - quote) / 100.0 / 365.0
+
+
+def _to_utc_ns(timestamps: pd.Series) -> Tuple[np.ndarray, np.ndarray]:
+    """(valid_mask, ns_since_epoch) — naive timestamps treated as UTC.
+    The cast goes through datetime64[ns, UTC] explicitly: pandas 3.0
+    keeps datetimes at microsecond resolution, where a bare
+    ``astype(int64)`` would yield microseconds."""
+    ts = pd.to_datetime(timestamps, errors="coerce")
+    try:
+        ts = ts.dt.tz_convert("UTC")
+    except TypeError:
+        ts = ts.dt.tz_localize("UTC")
+    valid = ts.notna().to_numpy()
+    ns = ts.astype("datetime64[ns, UTC]").astype("int64").to_numpy()
+    return valid, ns
+
+
+def rollover_mask(timestamps: pd.Series) -> np.ndarray:
+    """(n,) bool — True on the FIRST bar at/after 22:00 UTC of each
+    calendar day (naive timestamps are treated as UTC, matching the
+    calendar precompute).  Invalid timestamps never roll over."""
+    valid, ns = _to_utc_ns(timestamps)
+    day = ns // 86_400_000_000_000
+    second_of_day = (ns // 1_000_000_000) % 86_400
+    eligible = valid & (second_of_day >= ROLLOVER_UTC_SECONDS)
+    mask = np.zeros(len(ns), dtype=bool)
+    seen: set = set()
+    for i in np.flatnonzero(eligible):
+        key = int(day[i])
+        if key not in seen:
+            seen.add(key)
+            mask[i] = True
+    return mask
+
+
+def precompute_rollover_accrual(
+    timestamps: pd.Series,
+    rate_data: Any,
+    base_currency: str,
+    quote_currency: str,
+) -> np.ndarray:
+    """(n,) float64 — per-bar accrual rate column for the scan engine:
+    the pair's daily differential on rollover bars, 0 elsewhere.  The
+    step's financing credit is  pos * close * accrual[t]  in quote
+    currency (core/env.py), matching the replay engine's
+    units * mid * differential."""
+    table = parse_rate_table(rate_data)
+    mask = rollover_mask(timestamps)
+    out = np.zeros(len(mask), dtype=np.float64)
+    if not table:
+        return out
+    _, ns = _to_utc_ns(timestamps)
+    for i in np.flatnonzero(mask):
+        out[i] = daily_differential(table, base_currency, quote_currency, int(ns[i]))
+    return out
+
+
+def split_pair(instrument: str) -> Tuple[str, str]:
+    """'EUR_USD' / 'EUR/USD' / 'EURUSD' -> ('EUR', 'USD')."""
+    raw = str(instrument).upper().replace("/", "").replace("_", "").replace("-", "")
+    if len(raw) != 6 or not raw.isalpha():
+        raise ValueError(
+            f"cannot derive base/quote currencies from instrument {instrument!r}"
+        )
+    return raw[:3], raw[3:]

@@ -156,6 +156,64 @@ def build_financing_fixture() -> Tuple[
     return eurusd, frames, actions
 
 
+def build_limit_policy_fixture(*, exact_touch: bool) -> Tuple[
+    List[InstrumentSpec], List[MarketFrame], List[TargetAction]
+]:
+    """Long bracket whose TP (1.08800) is reached by bar 2's path.
+
+    ``exact_touch=True``: the path tick lands ON the limit — fills under
+    touch/cross, not under conservative (which needs a trade-through).
+    ``exact_touch=False``: the path tick JUMPS through to 1.08900 —
+    fills under every policy, at 1.08800 for conservative/touch and at
+    the (better) touching tick price under cross.  Meant to run with a
+    zero-spread/zero-slippage profile so tick prices equal mids.
+    """
+    eurusd = [_eurusd()]
+    touch_mid = 1.08800 if exact_touch else 1.08900
+    frames = [
+        _bar("EUR/USD.SIM", 1, _ts(1), 1.08400, 0.00015),
+        _bar(
+            "EUR/USD.SIM",
+            1,
+            _ts(2),
+            1.08600,
+            0.00015,
+            path=(1.08450, touch_mid, 1.08600),
+        ),
+    ]
+    actions = [
+        TargetAction(
+            "EUR/USD.SIM",
+            _ts(1),
+            1000.0,
+            "long-bracket",
+            stop_loss_price=1.08000,
+            take_profit_price=1.08800,
+        )
+    ]
+    return eurusd, frames, actions
+
+
+def build_latency_fixture() -> Tuple[
+    List[InstrumentSpec], List[MarketFrame], List[TargetAction]
+]:
+    """Three one-minute frames with distinct prices; an open at frame 1
+    demonstrates latency: with latency_ms=0 it fills at frame 1's close
+    (1.08400); with 0 < latency_ms <= 60_000 it fills at frame 2's first
+    path tick (1.08500)."""
+    eurusd = [_eurusd()]
+    frames = [
+        _bar("EUR/USD.SIM", 1, _ts(1), 1.08400, 0.00015),
+        _bar("EUR/USD.SIM", 1, _ts(2), 1.08500, 0.00015),
+        _bar("EUR/USD.SIM", 1, _ts(3), 1.08450, 0.00015),
+    ]
+    actions = [
+        TargetAction("EUR/USD.SIM", _ts(1), 1000.0, "delayed-open"),
+        TargetAction("EUR/USD.SIM", _ts(3), 0.0, "flatten"),
+    ]
+    return eurusd, frames, actions
+
+
 def build_rollover_rate_fixture() -> pd.DataFrame:
     """Monthly short-rate rows for the fixture currencies (schema of
     examples/data/fx_rollover_rates_smoke.csv)."""

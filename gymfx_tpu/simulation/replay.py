@@ -20,25 +20,39 @@ Execution model:
     (default: just the close), bid/ask displaced from mid by the
     profile's quote_adverse_rate_per_side (contracts.py:44-47);
   * a target action at a frame's timestamp nets against the current
-    position; market orders fill at the current top-of-book (ask for
-    buys, bid for sells) of that frame's LAST path tick;
+    position; with latency_ms == 0, market orders fill at the current
+    top-of-book (ask for buys, bid for sells) of that frame's LAST path
+    tick; with latency_ms > 0, the order (a fixed delta computed at
+    submission) is queued and fills at the FIRST path tick of the
+    earliest same-instrument frame at/after submission + latency — the
+    deterministic counterpart of the reference's LatencyModel
+    (reference simulation_engines/nautilus_adapter.py:415-417);
+  * fills pass through a seeded ``FillModel`` (counterpart of Nautilus'
+    FillModel(random_seed), reference nautilus_adapter.py:413): with the
+    default probabilities (limit 1.0 / stop 1.0 / slippage 0.0) it is a
+    deterministic pass-through, matching the reference's own defaults;
   * brackets (SL/TP on a flat->open action) are evaluated against every
     subsequent quote tick in path order, so intrabar collision ordering
-    is defined by the data's execution_path, not by a heuristic;
+    is defined by the data's execution_path, not by a heuristic; the
+    take-profit honors the profile's limit_fill_policy — conservative
+    (must trade strictly through; fills at the limit), touch (an exact
+    touch fills at the limit), cross (a touch fills at the touching
+    tick's market price — price improvement);
   * margin preflight: opening units require margin_init * notional
     (standard model) or margin_init * notional / leverage (leveraged
     model), converted to the account currency at the current mid;
     insufficient free balance -> preflight_denied, no order;
   * financing (when enabled): positions held across the 22:00 UTC
     rollover accrue interest from the annualized short-rate differential
-    of the pair (rate table rows LOCATION/TIME/Value, one row per
-    currency area per month — reference fixture schema
+    of the pair, month-aware (shared semantics: data/financing.py; rate
+    table rows LOCATION/TIME/Value — reference fixture schema
     examples/data/fx_rollover_rates_smoke.csv).
 """
 from __future__ import annotations
 
 import hashlib
 import json
+import random
 from dataclasses import asdict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -48,12 +62,64 @@ from gymfx_tpu.contracts import (
     MarketFrame,
     TargetAction,
 )
+from gymfx_tpu.data.financing import (
+    ROLLOVER_UTC_SECONDS,
+    daily_differential,
+    parse_rate_table,
+)
 
 ENGINE_NAME = "gymfx_tpu.scan_replay"
-ENGINE_VERSION = "1.0.0"
+ENGINE_VERSION = "1.1.0"
 
-ROLLOVER_UTC_SECONDS = 22 * 3600  # 17:00 New York standard time
-_CURRENCY_LOCATION = {"EUR": "EA19", "USD": "USA", "JPY": "JPN", "GBP": "GBR"}
+
+class FillModel:
+    """Seeded fill-probability model (Nautilus FillModel equivalent).
+
+    ``prob_fill_on_limit`` — chance a touched limit (TP) order fills on
+    that tick (an unfilled touch stays resting and re-rolls on the next
+    touch); ``prob_fill_on_stop`` — same for stop (SL) triggers;
+    ``prob_slippage`` — chance a market-order fill slips one tick
+    (10^-price_precision) further in the adverse direction.  The RNG is
+    seeded from ``profile.random_seed`` and consumed in event order, so
+    results are reproducible run-to-run and across processes (the
+    determinism contract the bake-off hashes assert).
+    """
+
+    def __init__(
+        self,
+        prob_fill_on_limit: float = 1.0,
+        prob_fill_on_stop: float = 1.0,
+        prob_slippage: float = 0.0,
+        random_seed: int = 0,
+    ) -> None:
+        for name, p in (
+            ("prob_fill_on_limit", prob_fill_on_limit),
+            ("prob_fill_on_stop", prob_fill_on_stop),
+            ("prob_slippage", prob_slippage),
+        ):
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must be within [0, 1]")
+        self.prob_fill_on_limit = float(prob_fill_on_limit)
+        self.prob_fill_on_stop = float(prob_fill_on_stop)
+        self.prob_slippage = float(prob_slippage)
+        self.random_seed = int(random_seed)
+        self._rng = random.Random(self.random_seed)
+
+    def _roll(self, p: float) -> bool:
+        if p >= 1.0:
+            return True
+        if p <= 0.0:
+            return False
+        return self._rng.random() < p
+
+    def limit_fills(self) -> bool:
+        return self._roll(self.prob_fill_on_limit)
+
+    def stop_fills(self) -> bool:
+        return self._roll(self.prob_fill_on_stop)
+
+    def slips(self) -> bool:
+        return self._roll(self.prob_slippage)
 
 
 def stable_hash(value: Any) -> str:
@@ -77,8 +143,32 @@ class _Position:
 class ReplayAdapter:
     """Run deterministic target-position scripts through the replay engine."""
 
-    def __init__(self, profile: ExecutionCostProfile) -> None:
+    def __init__(
+        self,
+        profile: ExecutionCostProfile,
+        *,
+        prob_fill_on_limit: float = 1.0,
+        prob_fill_on_stop: float = 1.0,
+        prob_slippage: float = 0.0,
+    ) -> None:
         self.profile = profile
+        # Probabilities are stored, not a FillModel instance: a FRESH
+        # seeded model is built per run() so repeated runs consume the
+        # same RNG sequence (the determinism-hash contract).
+        self._fill_probs = (
+            float(prob_fill_on_limit),
+            float(prob_fill_on_stop),
+            float(prob_slippage),
+        )
+
+    def make_fill_model(self) -> FillModel:
+        limit_p, stop_p, slip_p = self._fill_probs
+        return FillModel(
+            prob_fill_on_limit=limit_p,
+            prob_fill_on_stop=stop_p,
+            prob_slippage=slip_p,
+            random_seed=self.profile.random_seed,
+        )
 
     # ------------------------------------------------------------------
     def run(
@@ -112,7 +202,16 @@ class ReplayAdapter:
         balance = float(initial_cash)
         order_seq = 0
         order_count = 0
-        rates = _parse_rate_table(financing_rate_data)
+        rates = parse_rate_table(financing_rate_data)
+        fill_model = self.make_fill_model()
+        latency_ns = int(profile.latency_ms) * 1_000_000
+        limit_policy = profile.limit_fill_policy
+        # latency-delayed market orders waiting for their execution tick,
+        # plus the signed units they will move the book by — target
+        # deltas must net against position AND in-flight orders, or a
+        # target repeated across the latency window double-fills
+        pending_orders: List[Dict[str, Any]] = []
+        inflight_units: Dict[str, float] = {k: 0.0 for k in specs}
 
         # Timeline: all frames sorted by timestamp; ticks expanded per frame.
         frames_sorted = sorted(frames, key=lambda f: (f.ts_event_ns, f.instrument_id))
@@ -153,6 +252,7 @@ class ReplayAdapter:
             pos = positions[instrument_id]
             conv = conversion(spec, mid)
             signed = qty if side == "BUY" else -qty
+            units_before = pos.units
 
             if pos.units == 0 or pos.units * signed > 0:
                 new_units = pos.units + signed
@@ -198,6 +298,21 @@ class ReplayAdapter:
             )
             if pos.units == 0:
                 active_action.pop(instrument_id, None)
+            # a fill that closed or flipped the position invalidates any
+            # brackets protecting the OLD position (the scan engine's
+            # fill_pending clears brackets the same way); fresh brackets,
+            # if any, are armed by the caller after this returns
+            if pos.units == 0 or pos.units * units_before < 0:
+                brackets.pop(instrument_id, None)
+
+        def market_price(spec: InstrumentSpec, mid: float, side: str) -> float:
+            """Top-of-book fill price for a market order, with the fill
+            model's one-tick probabilistic slippage."""
+            price = mid * (1.0 + adverse) if side == "BUY" else mid * (1.0 - adverse)
+            if fill_model.slips():
+                tick = 10.0 ** (-spec.price_precision)
+                price = price + tick if side == "BUY" else price - tick
+            return price
 
         def check_brackets(instrument_id: str, bid: float, ask: float, mid: float, ts: int) -> None:
             nonlocal order_seq, order_count
@@ -208,17 +323,34 @@ class ReplayAdapter:
             long = pos.units > 0
             exit_qty = abs(pos.units)
             sl, tp = br["sl"], br["tp"]
+            # SL is a stop: triggers on a touch of the adverse book side.
+            # TP is a limit: its trigger follows the profile's
+            # limit_fill_policy — conservative requires trading strictly
+            # THROUGH the limit; touch/cross fill on an exact touch.
             if long:
                 sl_hit = bid <= sl
-                tp_hit = bid >= tp
+                tp_hit = bid > tp if limit_policy == "conservative" else bid >= tp
             else:
                 sl_hit = ask >= sl
-                tp_hit = ask <= tp
+                tp_hit = ask < tp if limit_policy == "conservative" else ask <= tp
             if not (sl_hit or tp_hit):
                 return
             # path order decides: this tick triggered one (or both — SL
-            # priority within a single tick, the conservative read)
-            exit_price = sl if sl_hit else tp
+            # priority within a single tick, the conservative read).
+            # An unfilled probabilistic trigger leaves the bracket armed
+            # for the next tick.
+            if sl_hit:
+                if not fill_model.stop_fills():
+                    return
+                exit_price = sl
+            else:
+                if not fill_model.limit_fills():
+                    return
+                if limit_policy == "cross":
+                    # price improvement: fill at the touching tick's book
+                    exit_price = bid if long else ask
+                else:
+                    exit_price = tp
             order_seq += 1
             order_count += 1
             fill(
@@ -232,6 +364,35 @@ class ReplayAdapter:
                 active_action.get(instrument_id, "bracket-exit"),
             )
             brackets.pop(instrument_id, None)
+
+        def flush_pending(frame: MarketFrame, first_mid: float) -> None:
+            """Fill latency-delayed orders due at/before this frame, at
+            its first path tick."""
+            nonlocal order_seq, order_count
+            due = [
+                po
+                for po in pending_orders
+                if po["instrument_id"] == frame.instrument_id
+                and frame.ts_event_ns >= po["execute_at_ns"]
+            ]
+            for po in due:
+                pending_orders.remove(po)
+                signed = po["qty"] if po["side"] == "BUY" else -po["qty"]
+                inflight_units[frame.instrument_id] -= signed
+                spec = specs[frame.instrument_id]
+                price = market_price(spec, first_mid, po["side"])
+                fill(
+                    frame.instrument_id,
+                    po["side"],
+                    po["qty"],
+                    price,
+                    first_mid,
+                    frame.ts_event_ns,
+                    po["order_id"],
+                    po["action_id"],
+                )
+                if po["arm_brackets"] and positions[frame.instrument_id].units != 0:
+                    brackets[frame.instrument_id] = {"sl": po["sl"], "tp": po["tp"]}
 
         def apply_rollover(ts: int) -> None:
             nonlocal balance, last_rollover_day
@@ -249,10 +410,12 @@ class ReplayAdapter:
                     continue
                 spec = specs[instrument_id]
                 mid = mid_of(instrument_id, pos.avg_price)
-                base_rate = rates.get(spec.base_currency, 0.0)
-                quote_rate = rates.get(spec.quote_currency, 0.0)
-                # long base earns base rate, pays quote rate (annualized %)
-                differential = (base_rate - quote_rate) / 100.0 / 365.0
+                # long base earns base rate, pays quote rate (annualized %,
+                # month-aware lookup shared with the scan precompute —
+                # data/financing.py)
+                differential = daily_differential(
+                    rates, spec.base_currency, spec.quote_currency, ts
+                )
                 interest_quote = pos.units * mid * differential
                 conv = conversion(spec, mid)
                 amount = interest_quote * conv
@@ -263,7 +426,7 @@ class ReplayAdapter:
                         "ts_event_ns": int(ts),
                         "instrument_id": instrument_id,
                         "position_units": _fmt(pos.units),
-                        "rate_differential_annual_pct": _fmt(base_rate - quote_rate),
+                        "rate_differential_annual_pct": _fmt(differential * 365.0 * 100.0),
                         "amount": _fmt(amount),
                         "currency": base_currency,
                     }
@@ -272,6 +435,9 @@ class ReplayAdapter:
         for frame in frames_sorted:
             spec = specs[frame.instrument_id]
             path: Tuple[float, ...] = tuple(frame.execution_path or (frame.close,))
+            # latency-delayed orders due by now fill at this frame's
+            # first path tick, before bracket evaluation
+            flush_pending(frame, path[0])
             # walk intrabar ticks: brackets can exit mid-path
             for mid in path:
                 bid = mid * (1.0 - adverse)
@@ -284,7 +450,9 @@ class ReplayAdapter:
             if action is None:
                 continue
             pos = positions[frame.instrument_id]
-            current = pos.units
+            # net the target against position AND in-flight (latency-
+            # delayed) orders so targets stay honored across the window
+            current = pos.units + inflight_units[frame.instrument_id]
             delta = float(action.target_units) - current
             emit(
                 {
@@ -303,7 +471,6 @@ class ReplayAdapter:
 
             mid = last_mid[frame.instrument_id]
             side = "BUY" if delta > 0 else "SELL"
-            fill_price = mid * (1.0 + adverse) if delta > 0 else mid * (1.0 - adverse)
 
             if profile.enforce_margin_preflight:
                 opening = 0.0
@@ -334,21 +501,53 @@ class ReplayAdapter:
             order_seq += 1
             order_count += 1
             order_id = f"O-{order_seq}"
+            wants_brackets = (
+                current == 0
+                and action.stop_loss_price is not None
+                and action.take_profit_price is not None
+            )
+            if latency_ns > 0:
+                # the submit->venue trip delays EXECUTION of new orders;
+                # resting brackets at the venue are unaffected
+                execute_at = frame.ts_event_ns + latency_ns
+                inflight_units[frame.instrument_id] += delta
+                pending_orders.append(
+                    {
+                        "instrument_id": frame.instrument_id,
+                        "execute_at_ns": execute_at,
+                        "side": side,
+                        "qty": abs(delta),
+                        "order_id": order_id,
+                        "action_id": action.action_id,
+                        "arm_brackets": wants_brackets,
+                        "sl": float(action.stop_loss_price or 0.0),
+                        "tp": float(action.take_profit_price or 0.0),
+                    }
+                )
+                emit(
+                    {
+                        "event_type": "order_submitted",
+                        "ts_event_ns": int(frame.ts_event_ns),
+                        "instrument_id": frame.instrument_id,
+                        "action_id": action.action_id,
+                        "client_order_id": order_id,
+                        "side": side,
+                        "quantity": _fmt(abs(delta)),
+                        "execute_at_ns": int(execute_at),
+                    }
+                )
+                continue
             fill(
                 frame.instrument_id,
                 side,
                 abs(delta),
-                fill_price,
+                market_price(spec, mid, side),
                 mid,
                 frame.ts_event_ns,
                 order_id,
                 action.action_id,
             )
-            if (
-                current == 0
-                and action.stop_loss_price is not None
-                and action.take_profit_price is not None
-            ):
+            if wants_brackets:
                 brackets[frame.instrument_id] = {
                     "sl": float(action.stop_loss_price),
                     "tp": float(action.take_profit_price),
@@ -379,6 +578,7 @@ class ReplayAdapter:
                 "iterations": len(frames_sorted),
                 "total_events": len(event_facts),
                 "total_orders": order_count,
+                "orders_pending_unexecuted": len(pending_orders),
                 "total_positions": len(
                     {e["instrument_id"] for e in event_facts if e["event_type"] == "order_filled"}
                 ),
@@ -386,18 +586,3 @@ class ReplayAdapter:
         }
 
 
-def _parse_rate_table(rate_data: Any) -> Dict[str, float]:
-    """LOCATION/TIME/Value rows -> currency -> latest annual rate (%)."""
-    if rate_data is None:
-        return {}
-    location_to_ccy = {v: k for k, v in _CURRENCY_LOCATION.items()}
-    rates: Dict[str, float] = {}
-    try:
-        rows = rate_data.to_dict("records")  # pandas DataFrame
-    except AttributeError:
-        rows = list(rate_data)
-    for row in rows:
-        ccy = location_to_ccy.get(str(row.get("LOCATION")))
-        if ccy:
-            rates[ccy] = float(row.get("Value", 0.0))
-    return rates

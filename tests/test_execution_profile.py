@@ -1,0 +1,501 @@
+"""Every ExecutionCostProfile field is honored (or loudly rejected) by
+both engines: limit_fill_policy fill semantics, deterministic latency_ms,
+the seeded fill-probability model, and rollover financing in the SCAN
+engine cross-checked against the replay engine to the cent.
+
+Counterpart surface in the reference: profile schema
+simulation_engines/contracts.py:22-106; FillModel/LatencyModel wiring
+nautilus_adapter.py:397-427; FX rollover nautilus_gym.py:276-290.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from gymfx_tpu.core import broker
+from gymfx_tpu.core.types import initial_state, make_env_config, make_env_params
+from gymfx_tpu.data import financing as fxfin
+from gymfx_tpu.simulation.fixtures import (
+    build_latency_fixture,
+    build_limit_policy_fixture,
+    build_rollover_rate_fixture,
+    default_profile,
+)
+from gymfx_tpu.simulation.oracle import reconcile_fills
+from gymfx_tpu.simulation.replay import FillModel, ReplayAdapter
+from tests.helpers import make_df, make_env
+
+PIP = 0.0001
+
+
+def _frictionless(**overrides):
+    return default_profile(
+        commission_rate_per_side=0.0,
+        full_spread_rate=0.0,
+        slippage_bps_per_side=0.0,
+        enforce_margin_preflight=False,
+        **overrides,
+    )
+
+
+def _fills(result):
+    return [e for e in result["events"] if e["event_type"] == "order_filled"]
+
+
+# ---------------------------------------------------------------------------
+# replay engine: limit_fill_policy
+# ---------------------------------------------------------------------------
+def test_replay_conservative_ignores_exact_touch():
+    instruments, frames, actions = build_limit_policy_fixture(exact_touch=True)
+    result = ReplayAdapter(_frictionless(limit_fill_policy="conservative")).run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )
+    assert len(_fills(result)) == 1  # entry only; TP never traded through
+    assert result["summary"]["positions_open"] == 1
+
+
+@pytest.mark.parametrize("policy", ["touch", "cross"])
+def test_replay_touch_and_cross_fill_on_exact_touch(policy):
+    instruments, frames, actions = build_limit_policy_fixture(exact_touch=True)
+    result = ReplayAdapter(_frictionless(limit_fill_policy=policy)).run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )
+    fills = _fills(result)
+    assert len(fills) == 2
+    assert float(fills[1]["price"]) == pytest.approx(1.08800)
+    assert result["summary"]["positions_open"] == 0
+
+
+def test_replay_policy_dependent_fill_prices_reconcile():
+    """A tick jumping through the limit: conservative/touch fill at the
+    limit, cross at the (better) touching tick — each reconciled by the
+    independent oracle."""
+    instruments, frames, actions = build_limit_policy_fixture(exact_touch=False)
+    final = {}
+    for policy in ("conservative", "touch", "cross"):
+        profile = _frictionless(limit_fill_policy=policy)
+        result = ReplayAdapter(profile).run(
+            instrument_specs=instruments, frames=frames, actions=actions
+        )
+        fills = _fills(result)
+        assert len(fills) == 2
+        expected_exit = 1.08900 if policy == "cross" else 1.08800
+        assert float(fills[1]["price"]) == pytest.approx(expected_exit)
+        oracle = reconcile_fills(
+            result, instruments, profile, initial_cash=100_000.0
+        )
+        assert abs(
+            float(result["summary"]["final_balance"])
+            - oracle["expected_final_balance"]
+        ) <= 0.02
+        final[policy] = float(result["summary"]["final_balance"])
+    assert final["cross"] > final["touch"] == final["conservative"]
+
+
+# ---------------------------------------------------------------------------
+# replay engine: latency_ms
+# ---------------------------------------------------------------------------
+def test_replay_latency_shifts_fill_to_next_frame():
+    instruments, frames, actions = build_latency_fixture()
+    profile0 = _frictionless(latency_ms=0)
+    profile30 = _frictionless(latency_ms=30_000)
+    r0 = ReplayAdapter(profile0).run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )
+    r30 = ReplayAdapter(profile30).run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )
+    assert float(_fills(r0)[0]["price"]) == pytest.approx(1.08400)
+    fills30 = _fills(r30)
+    assert float(fills30[0]["price"]) == pytest.approx(1.08500)
+    assert int(fills30[0]["ts_event_ns"]) > int(_fills(r0)[0]["ts_event_ns"])
+    submitted = [e for e in r30["events"] if e["event_type"] == "order_submitted"]
+    assert submitted and int(submitted[0]["execute_at_ns"]) == int(
+        submitted[0]["ts_event_ns"]
+    ) + 30_000 * 1_000_000
+    # the flatten at the LAST frame is still in flight when data ends
+    assert r30["native"]["orders_pending_unexecuted"] == 1
+    assert r0["native"]["orders_pending_unexecuted"] == 0
+
+
+def test_replay_latency_is_deterministic():
+    instruments, frames, actions = build_latency_fixture()
+    profile = _frictionless(latency_ms=30_000)
+    h1 = ReplayAdapter(profile).run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )["result_hash"]
+    h2 = ReplayAdapter(profile).run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )["result_hash"]
+    assert h1 == h2
+
+
+def test_replay_latency_targets_net_against_inflight_orders():
+    """A target repeated/changed inside the latency window must net
+    against in-flight orders, not double-fill or get dropped."""
+    from gymfx_tpu.contracts import InstrumentSpec, MarketFrame, TargetAction
+    from gymfx_tpu.simulation.fixtures import _bar, _eurusd, _ts
+
+    frames = [
+        _bar("EUR/USD.SIM", 1, _ts(i), 1.084 + i * 0.0001, 0.00015)
+        for i in range(1, 6)
+    ]
+    # open 1000 at t1 (fills t2), flatten at t2 (fills t3): the flatten
+    # delta must be computed against position+inflight (=1000), not the
+    # still-zero booked position
+    actions = [
+        TargetAction("EUR/USD.SIM", _ts(1), 1000.0, "open"),
+        TargetAction("EUR/USD.SIM", _ts(2), 0.0, "flatten"),
+    ]
+    result = ReplayAdapter(_frictionless(latency_ms=30_000)).run(
+        instrument_specs=[_eurusd()], frames=frames, actions=actions
+    )
+    fills = _fills(result)
+    assert [f["side"] for f in fills] == ["BUY", "SELL"]
+    assert result["summary"]["positions_open"] == 0
+    assert result["native"]["orders_pending_unexecuted"] == 0
+    # and a REPEATED identical target inside the window is a no-op
+    actions2 = [
+        TargetAction("EUR/USD.SIM", _ts(1), 1000.0, "open"),
+        TargetAction("EUR/USD.SIM", _ts(2), 1000.0, "open-again"),
+    ]
+    result2 = ReplayAdapter(_frictionless(latency_ms=30_000)).run(
+        instrument_specs=[_eurusd()], frames=frames, actions=actions2
+    )
+    assert len(_fills(result2)) == 1
+    assert float(_fills(result2)[0]["quantity"]) == pytest.approx(1000.0)
+
+
+def test_replay_flip_clears_stale_brackets():
+    """Flipping long->short must drop the long's brackets — the old SL
+    below the market must not phantom-stop the fresh short."""
+    from gymfx_tpu.contracts import TargetAction
+    from gymfx_tpu.simulation.fixtures import _bar, _eurusd, _ts
+
+    frames = [
+        _bar("EUR/USD.SIM", 1, _ts(1), 1.09000, 0.00015),
+        _bar("EUR/USD.SIM", 1, _ts(2), 1.09100, 0.00015),
+        _bar("EUR/USD.SIM", 1, _ts(3), 1.09050, 0.00015),
+    ]
+    actions = [
+        TargetAction(
+            "EUR/USD.SIM", _ts(1), 1000.0, "long",
+            stop_loss_price=1.08000, take_profit_price=1.09800,
+        ),
+        TargetAction("EUR/USD.SIM", _ts(2), -1000.0, "flip-short"),
+    ]
+    result = ReplayAdapter(_frictionless()).run(
+        instrument_specs=[_eurusd()], frames=frames, actions=actions
+    )
+    fills = _fills(result)
+    # entry + flip only; ask >= old SL (1.08) must NOT fire on frame 3
+    assert len(fills) == 2
+    assert result["summary"]["positions_open"] == 1
+
+
+# ---------------------------------------------------------------------------
+# replay engine: seeded fill-probability model
+# ---------------------------------------------------------------------------
+def test_fill_model_validates_probabilities():
+    with pytest.raises(ValueError):
+        FillModel(prob_fill_on_limit=1.5)
+
+
+def test_prob_fill_on_limit_zero_never_fills_tp():
+    instruments, frames, actions = build_limit_policy_fixture(exact_touch=True)
+    adapter = ReplayAdapter(
+        _frictionless(limit_fill_policy="touch"), prob_fill_on_limit=0.0
+    )
+    result = adapter.run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )
+    assert len(_fills(result)) == 1
+    assert result["summary"]["positions_open"] == 1
+
+
+def test_prob_slippage_one_worsens_market_fill_by_one_tick():
+    instruments, frames, actions = build_latency_fixture()
+    base = ReplayAdapter(_frictionless()).run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )
+    slipped = ReplayAdapter(_frictionless(), prob_slippage=1.0).run(
+        instrument_specs=instruments, frames=frames, actions=actions
+    )
+    tick = 10.0 ** -instruments[0].price_precision
+    for b, s in zip(_fills(base), _fills(slipped)):
+        adverse = tick if b["side"] == "BUY" else -tick
+        assert float(s["price"]) == pytest.approx(float(b["price"]) + adverse)
+
+
+def test_probabilistic_fills_reproducible_for_same_seed():
+    instruments, frames, actions = build_limit_policy_fixture(exact_touch=True)
+    kw = dict(instrument_specs=instruments, frames=frames, actions=actions)
+    mk = lambda seed: ReplayAdapter(
+        _frictionless(limit_fill_policy="touch", random_seed=seed),
+        prob_fill_on_limit=0.5,
+    )
+    assert mk(7).run(**kw)["event_hash"] == mk(7).run(**kw)["event_hash"]
+
+
+# ---------------------------------------------------------------------------
+# scan engine: limit_fill_policy
+# ---------------------------------------------------------------------------
+def _bracket_env(highs, lows, opens=None, **over):
+    n = len(highs)
+    closes = np.full(n, 1.1)
+    df = make_df(closes, opens=opens, highs=highs, lows=lows)
+    over.setdefault("strategy_plugin", "direct_fixed_sltp")
+    over.setdefault("sl_pips", 20.0)
+    over.setdefault("tp_pips", 40.0)
+    over.setdefault("pip_size", PIP)
+    return make_env(df, **over)
+
+
+def _run(env, actions):
+    s, _ = env.reset()
+    infos = []
+    for a in actions:
+        s, o, r, d, info = env.step(s, a)
+        infos.append(info)
+    return s, infos
+
+
+def test_scan_conservative_requires_trade_through():
+    # entry at open[1]=1.1 -> TP=1.1040; bar 2 high EXACTLY touches it
+    n = 10
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    highs[2] = 1.1040
+    s_cons, _ = _run(
+        _bracket_env(highs, lows, limit_fill_policy="conservative"), [1, 0, 0, 0]
+    )
+    s_touch, _ = _run(
+        _bracket_env(highs, lows, limit_fill_policy="touch"), [1, 0, 0, 0]
+    )
+    assert float(s_cons.pos) == 1.0  # still open: no trade-through
+    assert float(s_touch.pos) == 0.0
+    assert float(s_touch.equity_delta) == pytest.approx(1.1040 - 1.1, abs=1e-6)
+
+
+def test_scan_gap_fill_price_by_policy():
+    # bar 2 gaps open ABOVE the TP: cross fills at the open (price
+    # improvement), touch/conservative fill at the limit exactly
+    n = 10
+    highs = np.full(n, 1.1001)
+    lows = np.full(n, 1.0999)
+    opens = np.full(n, 1.1)
+    opens[2], highs[2] = 1.1080, 1.1090
+    results = {}
+    for policy in ("conservative", "touch", "cross"):
+        s, _ = _run(
+            _bracket_env(highs, lows, opens=opens, limit_fill_policy=policy),
+            [1, 0, 0, 0],
+        )
+        assert float(s.pos) == 0.0
+        results[policy] = float(s.equity_delta)
+    assert results["cross"] == pytest.approx(1.1080 - 1.1, abs=1e-6)
+    assert results["touch"] == pytest.approx(1.1040 - 1.1, abs=1e-6)
+    assert results["conservative"] == pytest.approx(1.1040 - 1.1, abs=1e-6)
+
+
+def test_scan_rejects_unknown_limit_fill_policy():
+    n = 10
+    highs = np.full(n, 1.1001)
+    with pytest.raises(ValueError, match="limit_fill_policy"):
+        _bracket_env(highs, highs, limit_fill_policy="optimistic")
+
+
+def test_scan_rejects_multi_bar_latency():
+    closes = np.full(12, 1.1)
+    profile = default_profile(latency_ms=120_000)  # 2 bars at M1
+    with pytest.raises(ValueError, match="latency_ms"):
+        make_env(
+            make_df(closes),
+            execution_cost_profile={
+                k: getattr(profile, k) for k in profile.__dataclass_fields__
+            },
+        )
+
+
+def test_scan_latency_guard_infers_bar_interval_from_data():
+    # no timeframe label: the guard must use the median bar spacing
+    # (1 min here), not a lenient fallback
+    closes = np.full(12, 1.1)
+    profile = default_profile(latency_ms=300_000, enforce_margin_preflight=False)
+    with pytest.raises(ValueError, match="latency_ms"):
+        make_env(
+            make_df(closes),
+            timeframe="",
+            execution_cost_profile={
+                k: getattr(profile, k) for k in profile.__dataclass_fields__
+            },
+        )
+
+
+def test_scan_accepts_sub_bar_latency():
+    closes = np.full(12, 1.1)
+    profile = default_profile(latency_ms=500, enforce_margin_preflight=False)
+    env = make_env(
+        make_df(closes),
+        execution_cost_profile={
+            k: getattr(profile, k) for k in profile.__dataclass_fields__
+        },
+    )
+    assert env.cfg.limit_fill_policy == "conservative"
+
+
+# ---------------------------------------------------------------------------
+# scan engine: rollover financing, cross-checked against the replay engine
+# ---------------------------------------------------------------------------
+def _financing_df(n=12):
+    """1-min bars straddling the 22:00 UTC rollover (21:55 .. 22:06)."""
+    closes = np.full(n, 1.08400)
+    return make_df(closes, start="2024-03-05 21:55:00", freq="1min")
+
+
+def test_scan_financing_requires_rate_file():
+    with pytest.raises(ValueError, match="financing_rate_data_file"):
+        make_env(_financing_df(), financing_enabled=True)
+
+
+def test_scan_financing_accrues_at_rollover(tmp_path):
+    rate_csv = tmp_path / "rates.csv"
+    build_rollover_rate_fixture().to_csv(rate_csv, index=False)
+    env = make_env(
+        _financing_df(),
+        financing_enabled=True,
+        financing_rate_data_file=str(rate_csv),
+        position_size=1000.0,
+    )
+    # long 1000 opened at bar 1 open, held across 22:00
+    s, infos = _run(env, [1] + [0] * 9)
+    # EUR 4.5% vs USD 5.25% -> long EURUSD PAYS the differential
+    expected = 1000.0 * 1.08400 * (4.5 - 5.25) / 100.0 / 365.0
+    assert float(s.cash_delta) != 0.0
+    # cash = -entry notional + accrual (no commissions); strip the entry leg
+    accrual = float(s.cash_delta) + 1000.0 * 1.08400
+    assert accrual == pytest.approx(expected, abs=1e-4)
+    assert accrual < 0.0
+
+
+def test_scan_financing_matches_replay_to_the_cent(tmp_path):
+    """The same held-position-over-rollover scenario, scan vs replay."""
+    from gymfx_tpu.contracts import InstrumentSpec, MarketFrame, TargetAction
+
+    rate_df = build_rollover_rate_fixture()
+    rate_csv = tmp_path / "rates.csv"
+    rate_df.to_csv(rate_csv, index=False)
+
+    df = _financing_df()
+    env = make_env(
+        df,
+        financing_enabled=True,
+        financing_rate_data_file=str(rate_csv),
+        position_size=1000.0,
+    )
+    s, _ = _run(env, [1] + [0] * 9)
+    scan_accrual = float(s.cash_delta) + 1000.0 * 1.08400
+
+    spec = InstrumentSpec(
+        symbol="EUR/USD", venue="SIM", base_currency="EUR", quote_currency="USD",
+        price_precision=5, size_precision=0, margin_init=0.04, margin_maint=0.02,
+    )
+    ts_ns = [int(t.value) for t in pd.to_datetime(df.index, utc=True)]
+    frames = [
+        MarketFrame(
+            instrument_id="EUR/USD.SIM", timeframe_minutes=1, ts_event_ns=t,
+            open=1.08400, high=1.08400, low=1.08400, close=1.08400, volume=0.0,
+        )
+        for t in ts_ns
+    ]
+    actions = [TargetAction("EUR/USD.SIM", ts_ns[0], 1000.0, "open")]
+    result = ReplayAdapter(_frictionless(financing_enabled=True)).run(
+        instrument_specs=[spec], frames=frames, actions=actions,
+        financing_rate_data=rate_df,
+    )
+    financing_events = [
+        e for e in result["events"] if e["event_type"] == "financing_applied"
+    ]
+    assert len(financing_events) == 1
+    replay_accrual = float(financing_events[0]["amount"])
+    assert scan_accrual == pytest.approx(replay_accrual, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# financing precompute units
+# ---------------------------------------------------------------------------
+def test_rollover_mask_fires_once_per_day():
+    ts = pd.Series(
+        pd.to_datetime(
+            [
+                "2024-03-05 21:59", "2024-03-05 22:00", "2024-03-05 22:01",
+                "2024-03-06 10:00", "2024-03-06 22:30", "2024-03-06 23:00",
+            ]
+        )
+    )
+    mask = fxfin.rollover_mask(ts)
+    assert mask.tolist() == [False, True, False, False, True, False]
+
+
+def test_rate_table_is_month_aware():
+    table = fxfin.parse_rate_table(
+        pd.DataFrame(
+            [
+                {"LOCATION": "USA", "TIME": "2024-01", "Value": 4.0},
+                {"LOCATION": "USA", "TIME": "2024-03", "Value": 5.0},
+            ]
+        )
+    )
+    jan = int(pd.Timestamp("2024-01-15", tz="UTC").value)
+    feb = int(pd.Timestamp("2024-02-15", tz="UTC").value)
+    mar = int(pd.Timestamp("2024-03-15", tz="UTC").value)
+    before = int(pd.Timestamp("2023-06-01", tz="UTC").value)
+    assert fxfin.rate_at(table, "USD", jan) == 4.0
+    assert fxfin.rate_at(table, "USD", feb) == 4.0  # holds until next month
+    assert fxfin.rate_at(table, "USD", mar) == 5.0
+    assert fxfin.rate_at(table, "USD", before) == 4.0  # earliest fallback
+    assert fxfin.rate_at(table, "CHF", mar) == 0.0
+
+
+def test_split_pair():
+    assert fxfin.split_pair("EUR_USD") == ("EUR", "USD")
+    assert fxfin.split_pair("usd/jpy") == ("USD", "JPY")
+    with pytest.raises(ValueError):
+        fxfin.split_pair("EURUSDX")
+
+
+# ---------------------------------------------------------------------------
+# broker kernel regression: reduce orders must not disarm live brackets
+# ---------------------------------------------------------------------------
+def test_reduce_fill_preserves_live_brackets():
+    import jax.numpy as jnp
+
+    cfg = make_env_config({}, n_bars=10)
+    params = make_env_params({}, cfg)
+    state = initial_state(cfg)
+    state = state._replace(
+        pos=jnp.asarray(2.0), entry_price=jnp.asarray(1.1),
+        bracket_sl=jnp.asarray(1.09), bracket_tp=jnp.asarray(1.12),
+        pending_active=jnp.asarray(True), pending_target=jnp.asarray(1.0),
+    )
+    out = broker.fill_pending(state, jnp.asarray(1.1), params)
+    assert float(out.pos) == 1.0
+    assert float(out.bracket_sl) == pytest.approx(1.09)
+    assert float(out.bracket_tp) == pytest.approx(1.12)
+
+
+def test_flip_fill_rearms_brackets():
+    import jax.numpy as jnp
+
+    cfg = make_env_config({}, n_bars=10)
+    params = make_env_params({}, cfg)
+    state = initial_state(cfg)
+    state = state._replace(
+        pos=jnp.asarray(1.0), entry_price=jnp.asarray(1.1),
+        bracket_sl=jnp.asarray(1.09), bracket_tp=jnp.asarray(1.12),
+        pending_active=jnp.asarray(True), pending_target=jnp.asarray(-1.0),
+        pending_sl=jnp.asarray(1.13), pending_tp=jnp.asarray(1.07),
+    )
+    out = broker.fill_pending(state, jnp.asarray(1.1), params)
+    assert float(out.pos) == -1.0
+    assert float(out.bracket_sl) == pytest.approx(1.13)
+    assert float(out.bracket_tp) == pytest.approx(1.07)
