@@ -113,7 +113,8 @@ def step(
         a = jnp.where(raw >= thr, 1, jnp.where(raw <= -thr, 2, 0)).astype(jnp.int32)
     else:
         ai = jnp.asarray(action).reshape(-1)[0].astype(jnp.int32)
-        a = jnp.where((ai >= 0) & (ai <= 2), ai, 0)
+        hi = 3 if cfg.allow_flat_action else 2
+        a = jnp.where((ai >= 0) & (ai <= hi), ai, 0)
 
     # ---- event-context overlay (reference app/env.py:394-440) ------------
     a, state, event_info = _event_overlay(state, a, data, cfg, params)

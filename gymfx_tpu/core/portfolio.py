@@ -1,22 +1,42 @@
 """Multi-pair portfolio environment (BASELINE.json config 5).
 
 New capability: the reference env trades a single instrument; its only
-multi-asset surface is the Nautilus replay fixture.  Here the portfolio
-env is a first-class scan kernel over I instruments simultaneously:
-positions, pending orders and pnl conversion are (I,)-vectors, one step
-advances all pairs in lockstep, and the whole thing jits/vmaps/shards
-exactly like the single-pair core.
+multi-asset surface is the Nautilus replay fixture
+(reference simulation_engines/bakeoff.py:26-101, margin preflight with
+cross-currency conversion nautilus_adapter.py:191-237).  Here the
+portfolio env is the single-pair kernel itself, ``jax.vmap``-ed over an
+instrument axis — NOT a simplified sibling:
 
-Accounting: one account currency; each pair carries a per-bar
-conversion factor from its quote currency to the account currency
-(precomputed host-side: 1 for XXX/ACC pairs, 1/price for ACC/XXX
-pairs — the same direct-pair rule as the reconciliation oracle,
-simulation/oracle.py).  Cash effects of fills and mark-to-market pnl
-convert at the bar where they occur.
+  * each pair advances through the REAL ``core.env.step`` (pending
+    fills at next open, bracket SL/TP against the bar's H/L under the
+    profile's collision + limit-fill policies, ATR strategy with
+    session/weekend filter, event-context overlay, rollover financing,
+    full diagnostics) with its own quote-currency ledger and its own
+    ``EnvParams`` — per-pair execution-cost profiles are just different
+    rows of the stacked params pytree;
+  * one shared account couples the pairs: per-bar quote->account
+    conversion factors (direct pairs convert by rule, crosses bridge
+    through another pair in the book — same rule as the reconciliation
+    oracle, simulation/oracle.py), account-level margin preflight over
+    the opening margin of ALL newly-submitted orders (greedy in pair
+    order, deterministic), account-level reward kernels
+    (pnl/sharpe/dd with the explicit carries of core/rewards.py), the
+    stage-B force-close penalty, and account-level bankruptcy
+    termination.
 
-Timing matches the single-pair kernel: actions at bar t create pending
-orders that fill at bar t+1's open; equity marks at every close; the
-first step is the same-bar warmup.
+Accounting note: each pair's ledger lives in its quote currency and is
+converted at the CURRENT bar's rate when the account is marked, so
+realized pnl "parked" in a foreign quote currency floats with FX until
+the episode ends — how a real multi-currency margin account behaves
+before sweeps.  The replay engine (like Nautilus) converts realized pnl
+at fill time; the difference is conversion drift on already-realized
+pnl, covered by the bake-off tolerance at fixture scale (see
+DIVERGENCES.md).
+
+Static-policy constraint: per-pair profiles may differ in every numeric
+field (commission, spread, slippage, margin), but fields that select
+compiled code paths (collision policy, limit-fill policy, margin model,
+financing) must agree across pairs — one XLA program serves all pairs.
 """
 from __future__ import annotations
 
@@ -28,59 +48,76 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from gymfx_tpu.core import broker
+from gymfx_tpu.core import env as env_core
+from gymfx_tpu.core import rewards
+from gymfx_tpu.core.types import (
+    EXEC_DIAG_INDEX,
+    EnvConfig,
+    EnvParams,
+    EnvState,
+    initial_state,
+    make_env_config,
+    make_env_params,
+)
+from gymfx_tpu.data.feed import MarketData, MarketDataset
+
 
 class PortfolioData(NamedTuple):
-    open: Any      # (n, I)
-    high: Any      # (n, I)
-    low: Any       # (n, I)
-    close: Any     # (n, I)
-    conv: Any      # (n, I) quote->account conversion factor
-    padded_close: Any  # (n + w, I)
+    pair: MarketData   # every leaf stacked with a leading (I,) axis
+    conv: Any          # (n, I) quote->account conversion factor
 
     @property
     def n_bars(self) -> int:
-        return int(self.close.shape[0])
+        return int(self.pair.close.shape[1])
 
     @property
     def n_pairs(self) -> int:
-        return int(self.close.shape[1])
+        return int(self.pair.close.shape[0])
+
+    # (n, I) convenience views matching the portfolio layout
+    @property
+    def open(self):
+        return self.pair.open.T
+
+    @property
+    def high(self):
+        return self.pair.high.T
+
+    @property
+    def low(self):
+        return self.pair.low.T
+
+    @property
+    def close(self):
+        return self.pair.close.T
 
 
 @dataclasses.dataclass(frozen=True)
 class PortfolioConfig:
     n_pairs: int
     n_bars: int
-    window_size: int = 32
-    margin_rate: float = 0.0   # 0 disables the margin preflight
+    window_size: int
+    pair_cfg: EnvConfig    # inner per-pair kernel config
+    acct_cfg: EnvConfig    # account-level reward/penalty config
+    enforce_margin_preflight: bool = False
+    margin_model: str = "leveraged"
     dtype: Any = jnp.float32
 
 
 class PortfolioParams(NamedTuple):
-    initial_cash: Any
-    position_size: Any     # (I,) units per order
-    commission: Any
-    slippage: Any
-    leverage: Any
-    min_equity: Any
-    reward_scale: Any
+    pair: EnvParams        # every leaf (I,); margin_init is per-pair here
+    acct: EnvParams        # scalars (account currency)
 
 
 class PortfolioState(NamedTuple):
-    t: Any
-    started: Any
-    terminated: Any
-    pos: Any               # (I,) signed units
-    entry: Any             # (I,) avg entry price
-    cash_delta: Any        # scalar, account currency
-    equity_delta: Any
-    prev_equity_delta: Any
-    commission_paid: Any
-    trade_count: Any       # i32 scalar
-    pending_active: Any    # (I,) bool
-    pending_target: Any    # (I,)
-    blocked_margin: Any    # i32 counter
+    pairs: EnvState        # every leaf with a leading (I,) axis
+    acct: EnvState         # scalar account-level carry
 
 
+# ---------------------------------------------------------------------------
+# host-side data loading
+# ---------------------------------------------------------------------------
 def load_portfolio_frames(
     files: Dict[str, str],
     *,
@@ -108,20 +145,15 @@ def load_portfolio_frames(
     return list(files.keys()), aligned
 
 
-def build_portfolio_data(
+def build_conversion_factors(
     pairs: Sequence[str],
-    aligned: Dict[str, pd.DataFrame],
-    *,
-    window_size: int,
+    closes: np.ndarray,          # (n, I) float64
     account_currency: str = "USD",
-    dtype: Any = jnp.float32,
-) -> PortfolioData:
-    n = len(next(iter(aligned.values())))
-    cols = {k: np.stack([aligned[p][k].to_numpy(np.float64) for p in pairs], 1)
-            for k in ("OPEN", "HIGH", "LOW", "CLOSE")}
-    closes = cols["CLOSE"]
-    # quote-currency -> account-currency factors; crosses bridge through
-    # another pair in the book that quotes/bases the account currency
+) -> np.ndarray:
+    """(n, I) quote-currency -> account-currency factors; crosses bridge
+    through another pair in the book that quotes/bases the account
+    currency (same direct-pair rule as the reconciliation oracle)."""
+    n = closes.shape[0]
     parsed = [p.replace("/", "_").split("_", 1) for p in pairs]
     conv = np.ones((n, len(pairs)))
     for i, (base, quote) in enumerate(parsed):
@@ -144,195 +176,218 @@ def build_portfolio_data(
                     f"{account_currency} and no bridging pair in the book"
                 )
             conv[:, i] = bridge
-    padded = np.concatenate(
-        [np.tile(cols["CLOSE"][:1], (window_size, 1)), cols["CLOSE"]], axis=0
-    )
-    return PortfolioData(
-        open=jnp.asarray(cols["OPEN"], dtype),
-        high=jnp.asarray(cols["HIGH"], dtype),
-        low=jnp.asarray(cols["LOW"], dtype),
-        close=jnp.asarray(cols["CLOSE"], dtype),
-        conv=jnp.asarray(conv, dtype),
-        padded_close=jnp.asarray(padded, dtype),
-    )
+    return conv
 
 
 # ---------------------------------------------------------------------------
+# pure kernel: reset / step
+# ---------------------------------------------------------------------------
 def reset(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData):
-    d = cfg.dtype
-    I = cfg.n_pairs
-    z = jnp.zeros((), d)
-    state = PortfolioState(
-        t=jnp.zeros((), jnp.int32),
-        started=jnp.zeros((), bool),
-        terminated=jnp.zeros((), bool),
-        pos=jnp.zeros((I,), d),
-        entry=jnp.zeros((I,), d),
-        cash_delta=z,
-        equity_delta=z,
-        prev_equity_delta=z,
-        commission_paid=z,
-        trade_count=jnp.zeros((), jnp.int32),
-        pending_active=jnp.zeros((I,), bool),
-        pending_target=jnp.zeros((I,), d),
-        blocked_margin=jnp.zeros((), jnp.int32),
+    pair_reset = lambda p, d: env_core.reset(cfg.pair_cfg, p, d)  # noqa: E731
+    pairs, obs_i = jax.vmap(pair_reset)(params.pair, data.pair)
+    acct = initial_state(cfg.acct_cfg)
+    eq = jnp.sum(data.conv[0] * pairs.equity_delta).astype(acct.equity_delta.dtype)
+    acct = acct._replace(
+        equity_delta=eq,
+        prev_equity_delta=eq,
+        peak_equity_delta=jnp.maximum(acct.peak_equity_delta, eq),
     )
-    return state, build_obs(state, data, cfg, params)
-
-
-def build_obs(state, data: PortfolioData, cfg: PortfolioConfig, params):
-    w = cfg.window_size
-    step = jnp.minimum(state.t + 1, cfg.n_bars)
-    prices = jax.lax.dynamic_slice(
-        data.padded_close, (step, jnp.zeros((), step.dtype)), (w, cfg.n_pairs)
-    )
-    returns = prices - jnp.concatenate([prices[:1], prices[:-1]])
-    initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
-    return {
-        "prices": prices.astype(jnp.float32),
-        "returns": returns.astype(jnp.float32),
-        "position": jnp.sign(state.pos).astype(jnp.float32),
-        "equity_norm": jnp.asarray(
-            [state.equity_delta / initial], jnp.float32
-        ),
-        "steps_remaining_norm": jnp.asarray(
-            [jnp.maximum(0, cfg.n_bars - (state.t + 1)) / max(1, cfg.n_bars)],
-            jnp.float32,
-        ),
-    }
+    state = PortfolioState(pairs=pairs, acct=acct)
+    return state, _portfolio_obs(obs_i, state, data, cfg, params)
 
 
 def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
          state: PortfolioState, actions):
     """actions: (I,) ints in {0=hold, 1=long, 2=short, 3=flat}."""
-    n = cfg.n_bars
-    was_terminated = state.terminated
+    was_terminated = state.acct.terminated
     live = ~was_terminated
-    a = jnp.asarray(actions, jnp.int32).reshape(cfg.n_pairs)
-    a = jnp.where((a >= 0) & (a <= 3), a, 0)
 
-    advance = live & state.started & (state.t < n - 1)
-    exhausted = live & state.started & (state.t >= n - 1)
-    act = live & ~exhausted
-
-    t_new = jnp.where(advance, state.t + 1, state.t)
-    o = data.open[t_new]      # (I,)
-    c = data.close[t_new]
-    conv = data.conv[t_new]
-
-    pos, entry, cash = state.pos, state.entry, state.cash_delta
-    commission_paid = state.commission_paid
-    trade_count = state.trade_count
-
-    # ---- fill pending orders at the new bar's open -------------------
-    do_fill = advance & state.pending_active
-    target = jnp.where(do_fill, state.pending_target, pos)
-    delta = target - pos
-    direction = jnp.sign(delta)
-    fill = o * (1.0 + params.slippage * direction)
-    commission = params.commission * fill * jnp.abs(delta) * conv
-    # realized pnl on closed units, converted to the account currency
-    same_sign = pos * target > 0
-    closed = jnp.where(same_sign, jnp.maximum(jnp.abs(pos) - jnp.abs(target), 0.0),
-                       jnp.abs(pos))
-    closed = jnp.where(delta == 0, 0.0, closed)
-    realized = closed * (fill - entry) * jnp.sign(pos) * conv
-    cash = cash + jnp.sum(realized - commission)
-    commission_paid = commission_paid + jnp.sum(commission)
-
-    flipping = (~same_sign) & (target != 0) & (pos != 0)
-    opening = (pos == 0) & (target != 0)
-    adding = same_sign & (jnp.abs(target) > jnp.abs(pos))
-    new_entry = jnp.where(
-        adding,
-        (entry * jnp.abs(pos) + fill * (jnp.abs(target) - jnp.abs(pos)))
-        / jnp.maximum(jnp.abs(target), 1e-30),
-        entry,
+    # terminated account -> per-pair steps become no-ops (their own
+    # terminated flags were set when the account terminated)
+    pair_step = lambda p, d, s, a: env_core.step(  # noqa: E731
+        cfg.pair_cfg, p, d, s, a
     )
-    new_entry = jnp.where(flipping | opening, fill, new_entry)
-    new_entry = jnp.where(target == 0, 0.0, new_entry)
-    trade_closed = (pos != 0) & ((target == 0) | flipping)
-    # .astype: jnp.sum promotes int32 to int64 under jax_enable_x64,
-    # which breaks the scan-carry dtype contract
-    trade_count = trade_count + jnp.sum(trade_closed.astype(jnp.int32)).astype(jnp.int32)
-    pos = target
-    entry = new_entry
-
-    # ---- apply new actions at the close ------------------------------
-    size = params.position_size
-    want = jnp.where(
-        a == 1, size, jnp.where(a == 2, -size, jnp.where(a == 3, 0.0, jnp.nan))
-    )
-    submit = act & (a != 0) & (
-        (a == 3) & (pos != 0)
-        | (a == 1) & (pos <= 0)
-        | (a == 2) & (pos >= 0)
-    )
-    new_target = jnp.where(submit, jnp.nan_to_num(want), pos)
-
-    # optional margin preflight on the TOTAL post-fill book
-    if cfg.margin_rate > 0:
-        notional = jnp.sum(jnp.abs(new_target) * c * conv)
-        equity_now = params.initial_cash + cash + jnp.sum(pos * (c - entry) * conv)
-        required = notional * cfg.margin_rate / jnp.maximum(params.leverage, 1e-12)
-        margin_ok = required <= equity_now
-        blocked = submit & ~margin_ok & (jnp.abs(new_target) > jnp.abs(pos))
-        new_target = jnp.where(blocked, pos, new_target)
-        submit = submit & ~blocked
-        state_blocked = state.blocked_margin + jnp.sum(blocked.astype(jnp.int32)).astype(jnp.int32)
-    else:
-        state_blocked = state.blocked_margin
-
-    pending_active = jnp.where(act, submit & (new_target != pos), False)
-    pending_target = jnp.where(pending_active, new_target, 0.0)
-
-    # ---- mark to market ----------------------------------------------
-    unrealized = jnp.sum(pos * (c - entry) * conv)
-    equity_delta = jnp.where(
-        advance | (live & ~state.started), cash + unrealized, state.equity_delta
-    )
-    prev_equity_delta = jnp.where(
-        advance | (live & ~state.started), state.equity_delta,
-        state.prev_equity_delta,
+    pairs, obs_i, _pr, _pd, info_i = jax.vmap(pair_step)(
+        params.pair, data.pair, state.pairs,
+        jnp.asarray(actions, jnp.int32).reshape(cfg.n_pairs),
     )
 
-    initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
-    reward = jnp.where(
-        live, (equity_delta - prev_equity_delta) / initial * params.reward_scale, 0.0
-    )
-    equity = params.initial_cash + equity_delta
-    terminated = was_terminated | exhausted | (live & (equity <= params.min_equity))
+    t_new = pairs.t[0]
+    conv = data.conv[t_new]                        # (I,)
+    close = data.pair.close[jnp.arange(cfg.n_pairs), t_new]  # (I,)
 
-    new_state = PortfolioState(
+    # ---- account-level margin preflight over newly-submitted orders ----
+    # (the inner kernel's own preflight is disabled; the account gate
+    # sees the whole book).  Greedy in pair order: each order is granted
+    # only if the margin GRANTED so far plus its own still fits the free
+    # realized balance — denied orders reserve nothing, matching a
+    # sequential broker (and the replay engine) processing one order at
+    # a time.  Deterministic regardless of XLA scheduling.
+    if cfg.enforce_margin_preflight:
+        opening = broker.opening_units(pairs.pos, pairs.pending_target)  # (I,)
+        required_q = opening * close * params.pair.margin_init
+        if cfg.margin_model == "leveraged":
+            required_q = required_q / jnp.maximum(params.pair.leverage, 1e-12)
+        required = required_q * conv               # account currency
+        free = params.acct.initial_cash + jnp.sum(
+            conv * (pairs.cash_delta + pairs.pos * pairs.entry_price)
+        )
+        want = pairs.pending_active & (opening > 0)
+
+        def grant_body(granted_sum, req_want):
+            req, wants = req_want
+            ok = wants & (granted_sum + req <= free)
+            return granted_sum + jnp.where(ok, req, 0.0), ok
+
+        _, granted = jax.lax.scan(
+            grant_body, jnp.zeros_like(free), (required, want)
+        )
+        denied = want & ~granted
+        pairs = pairs._replace(
+            pending_active=pairs.pending_active & ~denied,
+            pending_target=jnp.where(denied, 0.0, pairs.pending_target),
+            pending_sl=jnp.where(denied, 0.0, pairs.pending_sl),
+            pending_tp=jnp.where(denied, 0.0, pairs.pending_tp),
+            exec_diag=pairs.exec_diag.at[:, EXEC_DIAG_INDEX["preflight_denied"]].add(
+                denied.astype(jnp.int32)
+            ),
+        )
+
+    # ---- account equity mark + reward ---------------------------------
+    acct = state.acct
+    n = cfg.n_bars
+    advance = live & acct.started & (acct.t < n - 1)
+    exhausted = live & acct.started & (acct.t >= n - 1)
+    marking = advance | (live & ~acct.started)
+
+    eq = jnp.sum(conv * pairs.equity_delta).astype(acct.equity_delta.dtype)
+    acct = acct._replace(
         t=t_new,
-        started=state.started | live,
-        terminated=terminated,
-        pos=jnp.where(advance, pos, state.pos),
-        entry=jnp.where(advance, entry, state.entry),
-        cash_delta=jnp.where(advance, cash, state.cash_delta),
-        equity_delta=equity_delta,
-        prev_equity_delta=prev_equity_delta,
-        commission_paid=jnp.where(advance, commission_paid, state.commission_paid),
-        trade_count=jnp.where(advance, trade_count, state.trade_count),
-        pending_active=pending_active,
-        pending_target=pending_target,
-        blocked_margin=state_blocked,
+        started=acct.started | live,
+        prev_equity_delta=jnp.where(marking, acct.equity_delta, acct.prev_equity_delta),
+        equity_delta=jnp.where(marking, eq, acct.equity_delta),
+        pos=jnp.sum(jnp.abs(pairs.pos)).astype(acct.pos.dtype),
     )
-    obs = build_obs(new_state, data, cfg, params)
-    info = {
-        "equity": equity,
-        "equity_delta": equity_delta,
-        "positions": jnp.sign(new_state.pos).astype(jnp.int32),
-        "position_units": new_state.pos,
-        "bar_index": t_new + 1,
-        "trades": new_state.trade_count,
-        "commission_paid": new_state.commission_paid,
-        "blocked_margin": new_state.blocked_margin,
-    }
+    peak = jnp.where(marking, jnp.maximum(acct.peak_equity_delta, acct.equity_delta),
+                     acct.peak_equity_delta)
+    money_down = peak - acct.equity_delta
+    peak_equity = params.acct.initial_cash + peak
+    acct = acct._replace(
+        peak_equity_delta=peak,
+        max_drawdown_money=jnp.maximum(acct.max_drawdown_money, money_down),
+        max_drawdown_pct=jnp.maximum(
+            acct.max_drawdown_pct,
+            jnp.where(peak_equity > 0, money_down / peak_equity * 100.0, 0.0),
+        ),
+    )
+
+    acct, base_reward = rewards.compute_reward(acct, cfg.acct_cfg, params.acct, live)
+    fc_row = jnp.minimum(t_new + 1, n - 1)
+    penalty = rewards.force_close_penalty(
+        acct, data.pair.force_close[0, fc_row], cfg.acct_cfg, params.acct
+    )
+    penalty = jnp.where(live, penalty, 0.0)
+    reward = base_reward - penalty
+
+    # ---- account termination ------------------------------------------
+    equity = params.acct.initial_cash + acct.equity_delta
+    broke = equity <= params.acct.min_equity
+    terminated = was_terminated | exhausted | (live & broke)
+    acct = acct._replace(terminated=terminated)
+    pairs = pairs._replace(terminated=pairs.terminated | terminated)
+
+    new_state = PortfolioState(pairs=pairs, acct=acct)
+    obs = _portfolio_obs(obs_i, new_state, data, cfg, params)
+    info = _portfolio_info(info_i, new_state, conv, cfg, params)
+    info["reward"] = reward
+    info["force_close_reward_penalty"] = penalty
     return new_state, obs, reward, terminated, info
 
 
+def _portfolio_obs(obs_i: Dict[str, Any], state: PortfolioState,
+                   data: PortfolioData, cfg: PortfolioConfig,
+                   params: PortfolioParams) -> Dict[str, Any]:
+    """Vmapped per-pair obs blocks -> portfolio layout: window blocks are
+    (window, I) (bars as the leading axis, pairs as channels), per-pair
+    scalars are (I,), account scalars are (1,)."""
+    obs: Dict[str, Any] = {}
+    if "features" in obs_i:
+        f = obs_i["features"]                  # (I, w, F)
+        obs["features"] = jnp.transpose(f, (1, 0, 2)).reshape(
+            f.shape[1], -1
+        )
+    if "prices" in obs_i:
+        obs["prices"] = obs_i["prices"].T      # (w, I)
+        obs["returns"] = obs_i["returns"].T
+    obs["position"] = obs_i["position"][:, 0]  # (I,)
+    obs["unrealized_pnl_norm"] = obs_i["unrealized_pnl_norm"][:, 0]
+    initial = jnp.where(params.acct.initial_cash == 0, 1.0, params.acct.initial_cash)
+    obs["equity_norm"] = jnp.asarray(
+        [state.acct.equity_delta / initial], jnp.float32
+    )
+    obs["steps_remaining_norm"] = jnp.asarray(
+        [jnp.maximum(0, cfg.n_bars - (state.acct.t + 1)) / max(1, cfg.n_bars)],
+        jnp.float32,
+    )
+    # shared-timestamp blocks (stage-B / calendar) are identical across
+    # pairs: surface pair 0's.  Account-DEPENDENT calendar entries are
+    # excluded and re-emitted from the account ledger below — pair 0's
+    # quote-currency view would be wrong for the book.
+    account_dependent = ("margin_available_norm", "margin_closeout_percent")
+    for key, val in obs_i.items():
+        if key not in obs and key not in (
+            "position", "unrealized_pnl_norm", "equity_norm",
+            "steps_remaining_norm", *account_dependent,
+        ):
+            obs[key] = val[0]
+    if "margin_available_norm" in obs_i:
+        obs["margin_closeout_percent"] = jnp.zeros((1,), jnp.float32)
+        obs["margin_available_norm"] = jnp.asarray(
+            [(params.acct.initial_cash + state.acct.equity_delta) / initial],
+            jnp.float32,
+        )
+    return obs
+
+
+def _portfolio_info(info_i: Dict[str, Any], state: PortfolioState, conv,
+                    cfg: PortfolioConfig, params: PortfolioParams) -> Dict[str, Any]:
+    pairs = state.pairs
+    equity = params.acct.initial_cash + state.acct.equity_delta
+    info = {
+        "equity": equity,
+        "equity_delta": state.acct.equity_delta,
+        "positions": jnp.sign(pairs.pos).astype(jnp.int32),
+        "position_units": pairs.pos,
+        "bar_index": state.acct.t + 1,
+        "trades": jnp.sum(pairs.trade_count).astype(jnp.int32),
+        "commission_paid": jnp.sum(conv * pairs.commission_paid),
+        "blocked_margin": jnp.sum(
+            pairs.exec_diag[:, EXEC_DIAG_INDEX["preflight_denied"]]
+        ).astype(jnp.int32),
+        "bracket_sl": pairs.bracket_sl,
+        "bracket_tp": pairs.bracket_tp,
+        "pending_active": pairs.pending_active,
+        "atr": info_i["atr"],
+        "max_drawdown_money": state.acct.max_drawdown_money,
+        "max_drawdown_pct": state.acct.max_drawdown_pct,
+        "trades_won": jnp.sum(pairs.trades_won).astype(jnp.int32),
+        "trades_lost": jnp.sum(pairs.trades_lost).astype(jnp.int32),
+    }
+    return info
+
+
 # ---------------------------------------------------------------------------
+# host-side binding
+# ---------------------------------------------------------------------------
+_STATIC_PROFILE_FIELDS = (
+    "intrabar_collision_policy",
+    "limit_fill_policy",
+    "margin_model",
+    "financing_enabled",
+    "enforce_margin_preflight",
+)
+
+
 class PortfolioEnvironment:
     """Host-side binding: pair CSVs -> jitted portfolio reset/step."""
 
@@ -340,6 +395,7 @@ class PortfolioEnvironment:
         files = config.get("portfolio_files")
         if not files:
             raise ValueError("portfolio env requires config['portfolio_files']")
+        self.config = dict(config)
         account = str(config.get("account_currency", "USD"))
         pairs, aligned = load_portfolio_frames(
             dict(files),
@@ -349,34 +405,137 @@ class PortfolioEnvironment:
         )
         self.pairs = pairs
         w = int(config.get("window_size", 32))
-        self.data = build_portfolio_data(
-            pairs, aligned, window_size=w, account_currency=account
+        n = len(next(iter(aligned.values())))
+        if n < w + 2:
+            raise ValueError("aligned portfolio data too short for the window")
+
+        profiles = self._load_profiles(config, pairs)
+        self._check_static_profile_agreement(profiles)
+        cfg0 = make_env_config(
+            config, n_bars=n, n_features=len(config.get("feature_columns") or []),
+            binary_mask=tuple(
+                c in set(config.get("feature_binary_columns") or [])
+                for c in (config.get("feature_columns") or [])
+            ),
+            profile=profiles[0],
+        )
+        # margin backcompat: the old portfolio key 'margin_rate' doubles
+        # as margin_init + enforcement flag
+        margin_rate = float(config.get("margin_rate", 0.0) or 0.0)
+        enforce = bool(cfg0.enforce_margin_preflight or margin_rate > 0)
+        # the inner kernel runs per-pair with the ACCOUNT-level gates off
+        pair_cfg = dataclasses.replace(
+            cfg0,
+            enforce_margin_preflight=False,
+            reward="pnl_reward",
+            stage_b_force_close_reward_penalty=False,
+            allow_flat_action=True,
+        )
+        acct_cfg = dataclasses.replace(
+            cfg0, n_features=0, include_prices=False, include_agent_state=False
         )
         self.cfg = PortfolioConfig(
             n_pairs=len(pairs),
-            n_bars=self.data.n_bars,
+            n_bars=n,
             window_size=w,
-            margin_rate=float(config.get("margin_rate", 0.0)),
+            pair_cfg=pair_cfg,
+            acct_cfg=acct_cfg,
+            enforce_margin_preflight=enforce,
+            margin_model=cfg0.margin_model,
+            dtype=cfg0.dtype,
         )
-        d = self.cfg.dtype
-        initial_cash = float(config.get("initial_cash", 10000.0))
-        min_eq = config.get("min_equity")
+
+        from gymfx_tpu.core.runtime import (
+            load_financing_rates,
+            validate_profile_latency,
+        )
+
+        financing_rate_data = load_financing_rates(
+            config, pair_cfg.financing_enabled
+        )
+
+        # per-pair market data through the SAME pipeline as the
+        # single-pair env, leaves stacked on a leading pair axis
+        datasets = [MarketDataset(aligned[p], config) for p in pairs]
+        mds = [
+            ds.build_market_data(
+                window_size=w,
+                feature_columns=tuple(config.get("feature_columns") or ()),
+                feature_scaling=str(config.get("feature_scaling", "rolling_zscore")),
+                feature_scaling_window=int(config.get("feature_scaling_window", 256)),
+                dtype=cfg0.dtype,
+                financing_rate_data=financing_rate_data,
+                instrument=p,
+            )
+            for p, ds in zip(pairs, datasets)
+        ]
+        stacked = MarketData(*(jnp.stack(leaves) for leaves in zip(*mds)))
+        closes = np.stack(
+            [aligned[p]["CLOSE"].to_numpy(np.float64) for p in pairs], 1
+        )
+        conv = build_conversion_factors(pairs, closes, account)
+        self.data = PortfolioData(
+            pair=stacked, conv=jnp.asarray(conv, cfg0.dtype)
+        )
+
+        # per-pair params (per-pair profiles + sizes), stacked to (I,)
         sizes = config.get("portfolio_position_sizes")
         if sizes is None:
             sizes = [float(config.get("position_size", 1.0))] * len(pairs)
-        self.params = PortfolioParams(
-            initial_cash=jnp.asarray(initial_cash, d),
-            position_size=jnp.asarray(sizes, d),
-            commission=jnp.asarray(float(config.get("commission", 0.0)), d),
-            slippage=jnp.asarray(
-                float(config.get("slippage_perc", config.get("slippage", 0.0)) or 0.0), d
-            ),
-            leverage=jnp.asarray(float(config.get("leverage", 1.0)), d),
-            min_equity=jnp.asarray(
-                float(initial_cash * 0.01 if min_eq is None else min_eq), d
-            ),
-            reward_scale=jnp.asarray(float(config.get("reward_scale", 1.0)), d),
+        overrides = config.get("portfolio_param_overrides") or {}
+        per_pair = []
+        for i, p in enumerate(pairs):
+            cfg_i = dict(config, position_size=float(sizes[i]), min_equity=None)
+            if margin_rate > 0 and "margin_init" not in cfg_i:
+                cfg_i["margin_init"] = margin_rate  # legacy portfolio key
+            cfg_i.update(overrides.get(p) or {})
+            params_i = make_env_params(cfg_i, pair_cfg, profile=profiles[i])
+            # pair ledgers never terminate on their own equity: the
+            # account gates bankruptcy
+            params_i = params_i._replace(
+                min_equity=jnp.asarray(-1e30, cfg0.dtype)
+            )
+            per_pair.append(params_i)
+        pair_params = EnvParams(
+            *(jnp.stack(leaves) for leaves in zip(*per_pair))
         )
+        acct_params = make_env_params(dict(config), acct_cfg, profile=profiles[0])
+        self.params = PortfolioParams(pair=pair_params, acct=acct_params)
+
+        # honor-or-reject: latency vs the shared bar interval
+        bar_ms = datasets[0].bar_interval_ms()
+        for prof in profiles:
+            validate_profile_latency(prof, bar_ms)
+
+    @staticmethod
+    def _load_profiles(config: Dict[str, Any], pairs: List[str]):
+        from gymfx_tpu.core.types import _parse_profile
+
+        shared = _parse_profile(config)
+        per_pair_raw = config.get("portfolio_profiles") or {}
+        profiles = []
+        for p in pairs:
+            raw = per_pair_raw.get(p)
+            if raw is None:
+                profiles.append(shared)
+            else:
+                profiles.append(_parse_profile({"execution_cost_profile": raw}))
+        return profiles
+
+    @staticmethod
+    def _check_static_profile_agreement(profiles):
+        bound = [p for p in profiles if p is not None]
+        if len(bound) < 2:
+            return
+        head = bound[0]
+        for other in bound[1:]:
+            for field in _STATIC_PROFILE_FIELDS:
+                if getattr(other, field) != getattr(head, field):
+                    raise ValueError(
+                        "per-pair profiles must agree on static policy field "
+                        f"{field!r} (one XLA program serves all pairs): "
+                        f"{getattr(head, field)!r} != {getattr(other, field)!r}"
+                    )
 
     def reset(self):
         return _jit_p_reset(self.cfg, self.params, self.data)

@@ -24,6 +24,45 @@ from gymfx_tpu.core.types import (
 from gymfx_tpu.data.feed import MarketData, MarketDataset, load_market_dataset
 
 
+def validate_profile_latency(profile, bar_ms: Optional[float]) -> None:
+    """Honor-or-reject: the scan engine's timing model (orders submitted
+    at a bar close fill at the next bar open) subsumes sub-bar latency
+    only; anything it cannot honor must fail loudly at binding time.
+    Shared by the single-pair and portfolio bindings."""
+    if profile is None or profile.latency_ms <= 0:
+        return
+    if bar_ms is None:
+        raise ValueError(
+            "cannot validate latency_ms: the dataset has neither a "
+            "timeframe label nor enough timestamps to infer the bar "
+            "interval; set the 'timeframe' config key"
+        )
+    if float(profile.latency_ms) > bar_ms:
+        raise ValueError(
+            f"latency_ms={profile.latency_ms} exceeds one bar "
+            f"({bar_ms:.0f} ms): the scan engine's execution model "
+            "(orders submitted at a bar close fill at the next bar "
+            "open) subsumes sub-bar latency only; use the replay "
+            "engine for multi-bar latency"
+        )
+
+
+def load_financing_rates(config: Dict[str, Any], financing_enabled: bool):
+    """Rate table for the scan engine's rollover accrual; required (same
+    error as the reference, simulation_engines/nautilus_gym.py:277-281)
+    whenever the bound profile/config enables financing."""
+    if not financing_enabled:
+        return None
+    rate_path = config.get("financing_rate_data_file")
+    if not rate_path:
+        raise ValueError(
+            "financing_rate_data_file is required by the selected cost profile"
+        )
+    import pandas as pd
+
+    return pd.read_csv(rate_path)
+
+
 class Environment:
     def __init__(self, config: Dict[str, Any], dataset: Optional[MarketDataset] = None):
         self.config = dict(config)
@@ -55,32 +94,10 @@ class Environment:
         # engine or fail loudly here — a profile must never be silently
         # degraded (reference wires these through Nautilus' LatencyModel /
         # FXRolloverInterestModule, simulation_engines/nautilus_gym.py:276-310).
-        if profile is not None and profile.latency_ms > 0:
-            bar_ms = self.dataset.bar_interval_ms()
-            if bar_ms is None:
-                raise ValueError(
-                    "cannot validate latency_ms: the dataset has neither a "
-                    "timeframe label nor enough timestamps to infer the bar "
-                    "interval; set the 'timeframe' config key"
-                )
-            if float(profile.latency_ms) > bar_ms:
-                raise ValueError(
-                    f"latency_ms={profile.latency_ms} exceeds one bar "
-                    f"({bar_ms:.0f} ms): the scan engine's execution model "
-                    "(orders submitted at a bar close fill at the next bar "
-                    "open) subsumes sub-bar latency only; use the replay "
-                    "engine for multi-bar latency"
-                )
-        financing_rate_data = None
-        if self.cfg.financing_enabled:
-            rate_path = self.config.get("financing_rate_data_file")
-            if not rate_path:
-                raise ValueError(
-                    "financing_rate_data_file is required by the selected cost profile"
-                )
-            import pandas as pd
-
-            financing_rate_data = pd.read_csv(rate_path)
+        validate_profile_latency(profile, self.dataset.bar_interval_ms())
+        financing_rate_data = load_financing_rates(
+            self.config, self.cfg.financing_enabled
+        )
 
         self.data: MarketData = self.dataset.build_market_data(
             window_size=self.cfg.window_size,

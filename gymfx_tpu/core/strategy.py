@@ -40,10 +40,15 @@ def apply_action(
     diag = state.exec_diag
     pos = state.pos
 
-    # --- hidden force-flat action (pre-plugin, reference bt_bridge.py:178) ---
+    # --- force-flat action (pre-plugin, reference bt_bridge.py:178) ---
+    # In the single-pair env action 3 only ever comes from the event
+    # overlay, so it counts as an overlay intervention; when the env
+    # exposes 3 as a PUBLIC action (allow_flat_action, portfolio env)
+    # voluntary flats must not inflate the overlay audit counter.
     force_flat = active & (a == 3) & (pos != 0)
     diag = _inc(diag, "default_orders_submitted", force_flat)
-    diag = _inc(diag, "event_context_forced_flat_orders", force_flat)
+    if not cfg.allow_flat_action:
+        diag = _inc(diag, "event_context_forced_flat_orders", force_flat)
 
     if cfg.strategy == "direct_atr_sltp":
         state, diag, pending = _atr_sltp(

@@ -67,6 +67,10 @@ class EnvConfig:
     feature_clip: float = 10.0
 
     action_space_mode: str = "discrete"      # discrete | continuous
+    # widen the discrete space to include 3=force-flat as a PUBLIC
+    # action (the portfolio env's per-pair action set; in the
+    # single-pair env 3 stays internal to the event overlay)
+    allow_flat_action: bool = False
     include_prices: bool = True
     include_agent_state: bool = True
     stage_b_force_close_obs: bool = False

@@ -1,0 +1,353 @@
+"""Portfolio engine parity with the single-pair kernel: per-pair
+brackets against per-pair H/L, ATR + session filter, account-level
+reward families, per-pair execution-cost profiles, portfolio financing —
+and a bracketed multi-pair cross-currency bake-off where the SCAN
+portfolio env and the REPLAY engine land on the same account balance,
+reconciled by the independent oracle to the reference's $0.02 tolerance
+(reference simulation_engines/bakeoff.py:26-163, tests/test_nautilus_bakeoff.py:56).
+"""
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gymfx_tpu.contracts import InstrumentSpec, MarketFrame, TargetAction
+from gymfx_tpu.core.portfolio import PortfolioEnvironment
+from gymfx_tpu.simulation.oracle import reconcile_fills
+from gymfx_tpu.simulation.replay import ReplayAdapter
+from gymfx_tpu.simulation.fixtures import default_profile
+
+
+def _write_pair_csv(path, closes, highs=None, lows=None, opens=None,
+                    start="2024-03-05 09:30:00"):
+    closes = np.asarray(closes, np.float64)
+    n = len(closes)
+    df = pd.DataFrame(
+        {
+            "DATE_TIME": pd.date_range(start, periods=n, freq="1min"),
+            "OPEN": np.asarray(opens, np.float64) if opens is not None else closes,
+            "HIGH": np.asarray(highs, np.float64) if highs is not None else closes,
+            "LOW": np.asarray(lows, np.float64) if lows is not None else closes,
+            "CLOSE": closes,
+            "VOLUME": np.zeros(n),
+        }
+    )
+    df.to_csv(path, index=False)
+    return str(path)
+
+
+def _run(env, action_rows):
+    s, obs = env.reset()
+    infos = []
+    for row in action_rows:
+        s, obs, r, d, info = env.step(s, np.asarray(row, np.int32))
+        infos.append(info)
+    return s, infos
+
+
+# ---------------------------------------------------------------------------
+# per-pair brackets against per-pair H/L
+# ---------------------------------------------------------------------------
+def test_portfolio_brackets_resolve_per_pair(tmp_path):
+    n = 10
+    # pair A: TP (1.1040) reached by bar 2's high; pair B: flat range
+    a_high = np.full(n, 1.1001); a_high[2] = 1.1050
+    a_low = np.full(n, 1.0999)
+    b = np.full(n, 1.2)
+    files = {
+        "EUR_USD": _write_pair_csv(tmp_path / "a.csv", np.full(n, 1.1),
+                                   highs=a_high, lows=a_low),
+        "GBP_USD": _write_pair_csv(tmp_path / "b.csv", b),
+    }
+    env = PortfolioEnvironment({
+        "portfolio_files": files, "window_size": 4,
+        "strategy_plugin": "direct_fixed_sltp",
+        "sl_pips": 20.0, "tp_pips": 40.0, "pip_size": 0.0001,
+        "initial_cash": 10000.0,
+    })
+    s, infos = _run(env, [[1, 1], [0, 0], [0, 0], [0, 0]])
+    pos = np.asarray(infos[-1]["position_units"])
+    assert pos[0] == 0.0          # EUR TP'd out intrabar via its OWN high
+    assert pos[1] == 1.0          # GBP still open (its H/L never triggered)
+    assert int(infos[-1]["trades_won"]) == 1
+    # account equity: EUR trade banked (tp - entry), GBP flat at entry
+    assert float(s.acct.equity_delta) == pytest.approx(1.1040 - 1.1, abs=1e-5)
+
+
+def test_portfolio_atr_strategy_and_session_filter(tmp_path):
+    n = 40
+    closes = np.full(n, 1.1)
+    files = {
+        "EUR_USD": _write_pair_csv(tmp_path / "a.csv", closes,
+                                   highs=closes + 0.001, lows=closes - 0.001,
+                                   start="2024-01-01 00:00:00"),  # a Monday
+    }
+    env = PortfolioEnvironment({
+        "portfolio_files": files, "window_size": 4,
+        "strategy_plugin": "direct_atr_sltp", "atr_period": 3,
+        "session_filter": True, "entry_dow_start": 0, "entry_hour_start": 12,
+        "force_close_dow": 4, "force_close_hour": 20,
+    })
+    s, infos = _run(env, [[1]] * 6)
+    # Monday 00:00-00:05 is outside the entry window: all entries blocked
+    assert np.asarray(infos[-1]["position_units"])[0] == 0.0
+    assert int(np.asarray(s.pairs.exec_diag)[0][2]) >= 1  # blocked_session_filter
+
+
+def test_portfolio_account_level_sharpe_reward(tmp_path):
+    n = 30
+    closes = 1.1 * (1.0 + 2e-4) ** np.arange(n)
+    files = {"EUR_USD": _write_pair_csv(tmp_path / "a.csv", closes)}
+    env = PortfolioEnvironment({
+        "portfolio_files": files, "window_size": 4,
+        "reward_plugin": "sharpe_reward", "sharpe_window": 8,
+        "portfolio_position_sizes": [1000.0],
+    })
+    s, _ = env.reset()
+    rewards_seen = []
+    for k in range(12):
+        s, o, r, d, info = env.step(s, np.asarray([1 if k == 0 else 0], np.int32))
+        rewards_seen.append(float(r))
+    # uptrend long: positive annualized sharpe after warmup
+    assert rewards_seen[-1] > 0.0
+    # and the account reward buffer is the carry being used
+    assert int(s.acct.reward_buffer_len) > 0
+
+
+def test_portfolio_per_pair_profiles(tmp_path):
+    n = 12
+    files = {
+        "EUR_USD": _write_pair_csv(tmp_path / "a.csv", np.full(n, 1.1)),
+        "GBP_USD": _write_pair_csv(tmp_path / "b.csv", np.full(n, 1.2)),
+    }
+    free = {
+        k: getattr(default_profile(
+            commission_rate_per_side=0.0, full_spread_rate=0.0,
+            slippage_bps_per_side=0.0, enforce_margin_preflight=False,
+        ), k)
+        for k in default_profile().__dataclass_fields__
+    }
+    costly = dict(free, commission_rate_per_side=0.001)
+    env = PortfolioEnvironment({
+        "portfolio_files": files, "window_size": 4,
+        "portfolio_position_sizes": [1000.0, 1000.0],
+        "portfolio_profiles": {"EUR_USD": free, "GBP_USD": costly},
+    })
+    s, infos = _run(env, [[1, 1], [0, 0]])
+    comm = np.asarray(s.pairs.commission_paid)
+    assert comm[0] == pytest.approx(0.0)
+    assert comm[1] == pytest.approx(0.001 * 1.2 * 1000.0, rel=1e-4)
+
+
+def test_portfolio_profiles_must_agree_on_static_policy(tmp_path):
+    n = 12
+    files = {
+        "EUR_USD": _write_pair_csv(tmp_path / "a.csv", np.full(n, 1.1)),
+        "GBP_USD": _write_pair_csv(tmp_path / "b.csv", np.full(n, 1.2)),
+    }
+    base = {
+        k: getattr(default_profile(enforce_margin_preflight=False), k)
+        for k in default_profile().__dataclass_fields__
+    }
+    other = dict(base, limit_fill_policy="cross")
+    with pytest.raises(ValueError, match="static policy"):
+        PortfolioEnvironment({
+            "portfolio_files": files, "window_size": 4,
+            "portfolio_profiles": {"EUR_USD": base, "GBP_USD": other},
+        })
+
+
+def test_portfolio_financing_accrues(tmp_path):
+    n = 12
+    files = {
+        "EUR_USD": _write_pair_csv(
+            tmp_path / "a.csv", np.full(n, 1.084),
+            start="2024-03-05 21:55:00",
+        ),
+    }
+    rates = pd.DataFrame([
+        {"LOCATION": "EA19", "TIME": "2024-03", "Value": 4.5},
+        {"LOCATION": "USA", "TIME": "2024-03", "Value": 5.25},
+    ])
+    rate_csv = tmp_path / "rates.csv"
+    rates.to_csv(rate_csv, index=False)
+    env = PortfolioEnvironment({
+        "portfolio_files": files, "window_size": 4,
+        "financing_enabled": True,
+        "financing_rate_data_file": str(rate_csv),
+        "portfolio_position_sizes": [1000.0],
+    })
+    s, infos = _run(env, [[1]] + [[0]] * 9)
+    accrual = float(np.asarray(s.pairs.cash_delta)[0]) + 1000.0 * 1.084
+    expected = 1000.0 * 1.084 * (4.5 - 5.25) / 100.0 / 365.0
+    assert accrual == pytest.approx(expected, abs=1e-4)
+
+
+def test_portfolio_margin_denied_orders_reserve_nothing(tmp_path):
+    """A denied earlier-pair order must not consume margin that would
+    block an affordable later-pair order (sequential-broker semantics,
+    matching the replay engine)."""
+    n = 12
+    files = {
+        "EUR_USD": _write_pair_csv(tmp_path / "a.csv", np.full(n, 1.1)),
+        "GBP_USD": _write_pair_csv(tmp_path / "b.csv", np.full(n, 1.2)),
+    }
+    env = PortfolioEnvironment({
+        "portfolio_files": files, "window_size": 4,
+        "initial_cash": 10000.0, "margin_rate": 0.05, "leverage": 1.0,
+        # pair 0's order needs 1.1*10^6*0.05 = 55k (denied);
+        # pair 1's needs 1.2*1000*0.05 = 60 (fits)
+        "portfolio_position_sizes": [1_000_000.0, 1000.0],
+    })
+    s, infos = _run(env, [[1, 1], [0, 0]])
+    assert np.asarray(infos[-1]["position_units"]).tolist() == [0.0, 1000.0]
+    assert int(infos[-1]["blocked_margin"]) == 1
+
+
+def test_portfolio_per_pair_margin_init_override(tmp_path):
+    n = 12
+    files = {
+        "EUR_USD": _write_pair_csv(tmp_path / "a.csv", np.full(n, 1.0)),
+        "GBP_USD": _write_pair_csv(tmp_path / "b.csv", np.full(n, 1.0)),
+    }
+    env = PortfolioEnvironment({
+        "portfolio_files": files, "window_size": 4,
+        "initial_cash": 100.0, "margin_rate": 0.05, "leverage": 1.0,
+        "portfolio_position_sizes": [1000.0, 1000.0],
+        # pair 1 demands 10x margin: 1000*1.0*0.5 = 500 > 100 denied;
+        # pair 0 needs 50 <= 100 granted
+        "portfolio_param_overrides": {"GBP_USD": {"margin_init": 0.5}},
+    })
+    s, infos = _run(env, [[1, 1], [0, 0]])
+    assert np.asarray(infos[-1]["position_units"]).tolist() == [1000.0, 0.0]
+
+
+def test_portfolio_voluntary_flat_not_counted_as_overlay(tmp_path):
+    n = 12
+    files = {"EUR_USD": _write_pair_csv(tmp_path / "a.csv", np.full(n, 1.1))}
+    env = PortfolioEnvironment({"portfolio_files": files, "window_size": 4})
+    s, infos = _run(env, [[1], [0], [3], [0]])
+    from gymfx_tpu.core.types import EXEC_DIAG_INDEX
+
+    diag = np.asarray(s.pairs.exec_diag)[0]
+    assert diag[EXEC_DIAG_INDEX["event_context_forced_flat_orders"]] == 0
+    assert np.asarray(infos[-1]["position_units"])[0] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# bracketed multi-pair cross-currency bake-off: scan env vs replay engine
+# ---------------------------------------------------------------------------
+def test_portfolio_bakeoff_scan_vs_replay_oracle(tmp_path):
+    """Long EUR/USD with a take-profit that fills intrabar off the H
+    column; short USD/JPY (JPY-quoted: realized pnl converts to USD)
+    flattened mid-episode.  The scan portfolio env and the replay engine
+    must land on the same final account balance, and the oracle must
+    reconcile the replay fills."""
+    n = 8
+    eur_close = np.array([1.0840, 1.0850, 1.0860, 1.0865, 1.0860, 1.0855,
+                          1.0850, 1.0850])
+    eur_open = np.concatenate([[eur_close[0]], eur_close[:-1]])
+    eur_high = eur_close + 0.0002
+    eur_low = eur_close - 0.0002
+    # TP = close[0] + 40 pips = 1.0880; bar 3's high reaches it
+    eur_high[3] = 1.0885
+    jpy_close = np.array([151.20, 151.25, 151.30, 151.28, 151.26, 151.24,
+                          151.22, 151.20])
+    jpy_open = np.concatenate([[jpy_close[0]], jpy_close[:-1]])
+    jpy_high = jpy_close + 0.02
+    jpy_low = jpy_close - 0.02
+
+    files = {
+        "EUR_USD": _write_pair_csv(tmp_path / "eur.csv", eur_close,
+                                   opens=eur_open, highs=eur_high, lows=eur_low),
+        "USD_JPY": _write_pair_csv(tmp_path / "jpy.csv", jpy_close,
+                                   opens=jpy_open, highs=jpy_high, lows=jpy_low),
+    }
+    commission = 0.00002
+    profile = default_profile(
+        commission_rate_per_side=commission, full_spread_rate=0.0,
+        slippage_bps_per_side=0.0, enforce_margin_preflight=False,
+        limit_fill_policy="touch",
+    )
+    profile_dict = {
+        k: getattr(profile, k) for k in profile.__dataclass_fields__
+    }
+    env = PortfolioEnvironment({
+        "portfolio_files": files, "window_size": 4,
+        "initial_cash": 100_000.0,
+        "strategy_plugin": "direct_fixed_sltp", "pip_size": 0.0001,
+        "sl_pips": 100.0, "tp_pips": 40.0,
+        "execution_cost_profile": profile_dict,
+        "portfolio_position_sizes": [1000.0, 2000.0],
+        # JPY brackets parked far away (pip 0.01 -> +/-10 JPY)
+        "portfolio_param_overrides": {
+            "USD_JPY": {"sl_pips": 1000.0, "tp_pips": 1000.0, "pip_size": 0.01}
+        },
+    })
+    # step 0 acts on bar 0 (fills at bar 1 open); flatten JPY at step 4
+    # (fills bar 5 open); EUR TP fills intrabar at bar 3
+    s, infos = _run(env, [[1, 2], [0, 0], [0, 0], [0, 0], [0, 3], [0, 0],
+                          [0, 0]])
+    assert np.asarray(infos[-1]["position_units"]).tolist() == [0.0, 0.0]
+    scan_final = 100_000.0 + float(s.acct.equity_delta)
+
+    # ---- the same scenario scripted through the replay engine --------
+    eur = InstrumentSpec(
+        symbol="EUR/USD", venue="SIM", base_currency="EUR",
+        quote_currency="USD", price_precision=5, size_precision=0,
+        margin_init=0.04, margin_maint=0.02,
+    )
+    jpy = InstrumentSpec(
+        symbol="USD/JPY", venue="SIM", base_currency="USD",
+        quote_currency="JPY", price_precision=3, size_precision=0,
+        margin_init=0.04, margin_maint=0.02,
+    )
+    t0 = int(pd.Timestamp("2024-03-05 09:30:00").value)
+    MIN = 60_000_000_000
+
+    def frames_for(iid, opens, highs, lows, closes):
+        out = []
+        for k in range(1, n):
+            ts = t0 + k * MIN
+            # the "open frame" carries action fills at the bar's open;
+            # the "range frame" walks L before H (worst-case ordering)
+            out.append(MarketFrame(iid, 1, ts, opens[k], opens[k], opens[k],
+                                   opens[k], 0.0, execution_path=(opens[k],)))
+            out.append(MarketFrame(
+                iid, 1, ts + MIN // 2, opens[k], highs[k], lows[k], closes[k],
+                0.0, execution_path=(lows[k], highs[k], closes[k]),
+            ))
+        return out
+
+    frames = frames_for("EUR/USD.SIM", eur_open, eur_high, eur_low, eur_close)
+    frames += frames_for("USD/JPY.SIM", jpy_open, jpy_high, jpy_low, jpy_close)
+    actions = [
+        TargetAction(
+            "EUR/USD.SIM", t0 + 1 * MIN, 1000.0, "eur-long",
+            stop_loss_price=float(eur_close[0]) - 0.0100,
+            take_profit_price=float(eur_close[0]) + 0.0040,
+        ),
+        TargetAction(
+            "USD/JPY.SIM", t0 + 1 * MIN, -2000.0, "jpy-short",
+            stop_loss_price=float(jpy_close[0]) + 10.0,
+            take_profit_price=float(jpy_close[0]) - 10.0,
+        ),
+        TargetAction("USD/JPY.SIM", t0 + 5 * MIN, 0.0, "jpy-flatten"),
+    ]
+    result = ReplayAdapter(profile).run(
+        instrument_specs=[eur, jpy], frames=frames, actions=actions,
+        initial_cash=100_000.0,
+    )
+    replay_final = float(result["summary"]["final_balance"])
+    assert result["summary"]["positions_open"] == 0
+
+    oracle = reconcile_fills(
+        result, [eur, jpy], profile, initial_cash=100_000.0
+    )
+    assert abs(replay_final - oracle["expected_final_balance"]) <= 0.02
+    # scan vs replay: same fills, same brackets, cross-currency pnl --
+    # within the bake-off tolerance (f32 scan ledger + conversion drift)
+    assert scan_final == pytest.approx(replay_final, abs=0.02)
+    # sanity: the scenario actually moved money
+    assert abs(replay_final - 100_000.0) > 1.0
