@@ -38,8 +38,29 @@ PLUGIN_GROUPS = {
 def _collect_plugin_defaults(config: Dict[str, Any]) -> Dict[str, Any]:
     merged: Dict[str, Any] = {}
     for key, group in PLUGIN_GROUPS.items():
-        merged.update(get_plugin_params(group, str(config[key])))
+        name = str(config[key])
+        try:
+            merged.update(get_plugin_params(group, name))
+        except ImportError:
+            # registered compute KERNELS (plugins/kernels.py) are selected
+            # through the same strategy_plugin/reward_plugin keys; their
+            # declared parameter defaults join the merge identically
+            from gymfx_tpu.plugins import kernels as _k
+
+            kernel_group = {
+                "strategy_plugin": _k.STRATEGY_GROUP,
+                "reward_plugin": _k.REWARD_GROUP,
+            }.get(key)
+            if kernel_group is None or not _has_kernel(kernel_group, name):
+                raise
+            merged.update(get_plugin_params(kernel_group, name))
     return merged
+
+
+def _has_kernel(group: str, name: str) -> bool:
+    from gymfx_tpu.plugins.registry import available
+
+    return name in available(group)
 
 
 def make_cli_driver(config: Dict[str, Any]):

@@ -105,6 +105,12 @@ def build_obs(
             [(params.initial_cash + state.equity_delta) / initial],
             dtype=jnp.float32,
         )
+
+    for name in cfg.obs_kernels:
+        # registered third-party obs blocks (plugins/kernels.py)
+        from gymfx_tpu.plugins import kernels as _k
+
+        obs.update(_k.get_obs_kernel(name)(state, data, cfg, params))
     return obs
 
 

@@ -319,8 +319,9 @@ def _portfolio_obs(obs_i: Dict[str, Any], state: PortfolioState,
     if "prices" in obs_i:
         obs["prices"] = obs_i["prices"].T      # (w, I)
         obs["returns"] = obs_i["returns"].T
-    obs["position"] = obs_i["position"][:, 0]  # (I,)
-    obs["unrealized_pnl_norm"] = obs_i["unrealized_pnl_norm"][:, 0]
+    if "position" in obs_i:
+        obs["position"] = obs_i["position"][:, 0]  # (I,)
+        obs["unrealized_pnl_norm"] = obs_i["unrealized_pnl_norm"][:, 0]
     initial = jnp.where(params.acct.initial_cash == 0, 1.0, params.acct.initial_cash)
     obs["equity_norm"] = jnp.asarray(
         [state.acct.equity_delta / initial], jnp.float32
@@ -330,16 +331,25 @@ def _portfolio_obs(obs_i: Dict[str, Any], state: PortfolioState,
         jnp.float32,
     )
     # shared-timestamp blocks (stage-B / calendar) are identical across
-    # pairs: surface pair 0's.  Account-DEPENDENT calendar entries are
-    # excluded and re-emitted from the account ledger below — pair 0's
-    # quote-currency view would be wrong for the book.
+    # pairs, so pair 0's copy is surfaced; that collapse is applied ONLY
+    # to the known timestamp-derived keys — anything else (a registered
+    # obs kernel's block may be per-pair state) keeps its full (I, ...)
+    # array.  Account-DEPENDENT calendar entries are excluded and
+    # re-emitted from the account ledger below — pair 0's quote-currency
+    # view would be wrong for the book.
+    from gymfx_tpu.data.calendar import FORCE_CLOSE_FEATURE_KEYS
+    from gymfx_tpu.core.obs import CALENDAR_OBS_KEYS
+
     account_dependent = ("margin_available_norm", "margin_closeout_percent")
+    shared_keys = set(FORCE_CLOSE_FEATURE_KEYS) | set(CALENDAR_OBS_KEYS)
+    handled = {
+        "position", "unrealized_pnl_norm", "equity_norm",
+        "steps_remaining_norm", *account_dependent,
+    }
     for key, val in obs_i.items():
-        if key not in obs and key not in (
-            "position", "unrealized_pnl_norm", "equity_norm",
-            "steps_remaining_norm", *account_dependent,
-        ):
-            obs[key] = val[0]
+        if key in obs or key in handled:
+            continue
+        obs[key] = val[0] if key in shared_keys else val
     if "margin_available_norm" in obs_i:
         obs["margin_closeout_percent"] = jnp.zeros((1,), jnp.float32)
         obs["margin_available_norm"] = jnp.asarray(
@@ -496,9 +506,9 @@ class PortfolioEnvironment:
                 min_equity=jnp.asarray(-1e30, cfg0.dtype)
             )
             per_pair.append(params_i)
-        pair_params = EnvParams(
-            *(jnp.stack(leaves) for leaves in zip(*per_pair))
-        )
+        # tree-map (not per-field zip): EnvParams.user may be a nested
+        # pytree of registered-kernel parameters
+        pair_params = jax.tree.map(lambda *xs: jnp.stack(xs), *per_pair)
         acct_params = make_env_params(dict(config), acct_cfg, profile=profiles[0])
         self.params = PortfolioParams(pair=pair_params, acct=acct_params)
 
@@ -525,8 +535,16 @@ class PortfolioEnvironment:
     @staticmethod
     def _check_static_profile_agreement(profiles):
         bound = [p for p in profiles if p is not None]
-        if len(bound) < 2:
+        if not bound:
             return
+        if len(bound) != len(profiles):
+            # a partial binding would silently apply pair 0's static
+            # policy (or none) to the profile-less pairs — reject
+            raise ValueError(
+                "portfolio_profiles must cover every pair (or bind one "
+                "shared execution_cost_profile): profiles must never be "
+                "silently degraded"
+            )
         head = bound[0]
         for other in bound[1:]:
             for field in _STATIC_PROFILE_FIELDS:

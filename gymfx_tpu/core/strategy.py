@@ -57,8 +57,21 @@ def apply_action(
         )
     elif cfg.strategy == "direct_fixed_sltp":
         pending = _fixed_sltp(state, a, c, params, active & (a != 3))
-    else:
+    elif cfg.strategy == "default":
         diag, pending = _default_flow(state, a, params, diag, active & (a != 3))
+    else:
+        # registered third-party kernel (plugins/kernels.py): returns
+        # (state, (submit, target, sl, tp)); its pending order fills at
+        # the next bar's open through the shared broker kernel.  The
+        # force-flat counter increments above must reach the kernel's
+        # state so they survive its _replace calls.
+        from gymfx_tpu.plugins import kernels as _k
+
+        state, pending = _k.get_strategy_kernel(cfg.strategy)(
+            state._replace(exec_diag=diag), a, o, h, l, c, minute_of_week,
+            cfg, params, active & (a != 3),
+        )
+        diag = state.exec_diag
 
     p_active, p_target, p_sl, p_tp = pending
     p_active = jnp.where(force_flat, True, p_active)

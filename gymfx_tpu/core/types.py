@@ -80,13 +80,14 @@ class EnvConfig:
     event_context_block_new_entries: bool = True
     event_context_force_flat: bool = False
 
-    strategy: str = "default"                # default | direct_fixed_sltp | direct_atr_sltp
+    strategy: str = "default"                # default | direct_fixed_sltp | direct_atr_sltp | registered kernel
     session_filter: bool = False
     sltp_risk_mode: str = "fixed_atr"        # fixed_atr | rel_volume_aware_atr | margin_aware_atr
     size_mode: str = "fx_units"              # fx_units | notional
     atr_period: int = 14
 
-    reward: str = "pnl_reward"               # pnl_reward | sharpe_reward | dd_penalized_reward
+    reward: str = "pnl_reward"               # pnl_reward | sharpe_reward | dd_penalized_reward | registered kernel
+    obs_kernels: Tuple[str, ...] = ()        # registered extra obs blocks
     sharpe_window: int = 64
     stage_b_force_close_reward_penalty: bool = False
 
@@ -101,12 +102,21 @@ class EnvConfig:
     dtype: Any = jnp.float32
 
     def __post_init__(self):
+        from gymfx_tpu.plugins import kernels as _k
+
         if self.action_space_mode not in ("discrete", "continuous"):
             raise ValueError("action_space_mode must be discrete|continuous")
-        if self.strategy not in ("default", "direct_fixed_sltp", "direct_atr_sltp"):
+        if self.strategy not in _k.BUILTIN_STRATEGIES and not _k.has_strategy_kernel(
+            self.strategy
+        ):
             raise ValueError(f"unknown strategy kernel {self.strategy!r}")
-        if self.reward not in ("pnl_reward", "sharpe_reward", "dd_penalized_reward"):
+        if self.reward not in _k.BUILTIN_REWARDS and not _k.has_reward_kernel(
+            self.reward
+        ):
             raise ValueError(f"unknown reward kernel {self.reward!r}")
+        for name in self.obs_kernels:
+            if not _k.has_obs_kernel(name):
+                raise ValueError(f"unknown obs kernel {name!r}")
         if self.margin_model not in ("standard", "leveraged"):
             raise ValueError(f"unknown margin_model {self.margin_model!r}")
         if self.intrabar_collision_policy not in ("worst_case", "adaptive", "ohlc"):
@@ -170,6 +180,10 @@ class EnvParams(NamedTuple):
 
     # margin preflight (instrument initial-margin fraction)
     margin_init: Any
+
+    # registered third-party kernel parameters ({config_key: scalar});
+    # an empty tuple when no custom kernel is selected
+    user: Any = ()
 
 
 class EnvState(NamedTuple):
@@ -328,6 +342,7 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         size_mode=str(config.get("size_mode", "fx_units")).lower(),
         atr_period=int(config.get("atr_period", 14)),
         reward=str(config.get("reward_plugin", "pnl_reward")),
+        obs_kernels=tuple(config.get("obs_plugins") or ()),
         sharpe_window=int(config.get("window", config.get("sharpe_window", 64))),
         stage_b_force_close_reward_penalty=bool(
             config.get("stage_b_force_close_reward_penalty", False)
@@ -345,7 +360,18 @@ def _strategy_kernel_name(config: Dict[str, Any]) -> str:
     name = str(config.get("strategy_plugin", "default_strategy"))
     if name in ("direct_fixed_sltp", "direct_atr_sltp"):
         return name
-    return "default"
+    if name in ("default", "default_strategy"):
+        # the reference's default_strategy is an action DRIVER, not an
+        # executor; the kernel equivalent is the default order flow
+        return "default"
+    from gymfx_tpu.plugins import kernels as _k
+
+    if _k.has_strategy_kernel(name):
+        return name
+    raise ValueError(
+        f"unknown strategy kernel {name!r}: not a built-in and not a "
+        "registered strategy kernel (plugins/kernels.py)"
+    )
 
 
 def make_env_params(config: Dict[str, Any], cfg: EnvConfig, profile=None) -> EnvParams:
@@ -429,7 +455,22 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig, profile=None) -> Env
                 config.get("force_close_window_hours", 4),
             )
         ),
+        user=_user_params(config, cfg, f),
     )
+
+
+def _user_params(config: Dict[str, Any], cfg: EnvConfig, f) -> Any:
+    """Numeric parameters declared by the selected registered kernels,
+    read from the merged config (plugins/kernels.py contract)."""
+    from gymfx_tpu.plugins import kernels as _k
+
+    schema = _k.user_param_schema(cfg.reward, cfg.strategy, cfg.obs_kernels)
+    if not schema:
+        return ()
+    return {
+        key: f(config.get(key, default) if config.get(key) is not None else default)
+        for key, default in sorted(schema.items())
+    }
 
 
 def initial_state(cfg: EnvConfig) -> EnvState:

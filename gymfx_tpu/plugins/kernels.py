@@ -1,0 +1,105 @@
+"""Open kernel registry: third-party compute inside the jitted step.
+
+The reference loads arbitrary third-party strategy/reward plugins via
+entry points and calls them per step (reference
+app/plugin_loader.py:12-48, app/bt_bridge.py:191-201).  The TPU
+counterpart cannot call Python objects inside a compiled step — but it
+CAN trace a registered PURE FUNCTION at compile time.  This module lets
+external code register such kernels and have ``EnvConfig`` select them
+by name, with no edits to core modules:
+
+  * reward kernels    fn(state, cfg, params, active) -> (state, reward)
+                      (the contract of core/rewards.compute_reward);
+  * strategy kernels  fn(state, a, o, h, l, c, mow, cfg, params, active)
+                      -> (state, (submit, target, sl, tp))
+                      (the contract of the built-in strategy kernels —
+                      the returned pending order fills at the next bar's
+                      open through the shared broker kernel);
+  * obs kernels       fn(state, data, cfg, params) -> dict of extra obs
+                      blocks, selected via the ``obs_plugins`` config
+                      list and appended by core/obs.build_obs.
+
+Kernels declare their numeric parameters as ``{config_key: default}``;
+the values are read from the merged config by ``make_env_params`` into
+the ``EnvParams.user`` pytree (so sweeps/PBT can mutate them without
+recompiling), reachable inside the kernel as ``params.user[key]``.
+
+Registered callables must be jax-traceable (no Python side effects, no
+data-dependent control flow) — they run under jit/vmap/scan like every
+built-in kernel.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+from gymfx_tpu.plugins import registry as _registry
+
+# Kernel groups live in the SAME registry as the classic plugin families
+# (plugins/registry.py) — one registration mechanism, one lookup surface.
+REWARD_GROUP = "reward.kernels"
+STRATEGY_GROUP = "strategy.kernels"
+OBS_GROUP = "obs.kernels"
+
+BUILTIN_REWARDS = ("pnl_reward", "sharpe_reward", "dd_penalized_reward")
+BUILTIN_STRATEGIES = ("default", "direct_fixed_sltp", "direct_atr_sltp")
+
+
+def register_reward_kernel(name: str, params: Dict[str, float] | None = None):
+    """Decorator: make ``name`` selectable via config ``reward_plugin``."""
+    if name in BUILTIN_REWARDS:
+        raise ValueError(f"cannot shadow built-in reward kernel {name!r}")
+    return _registry.register(REWARD_GROUP, name, params)
+
+
+def register_strategy_kernel(name: str, params: Dict[str, float] | None = None):
+    """Decorator: make ``name`` selectable via config ``strategy_plugin``."""
+    if name in BUILTIN_STRATEGIES + ("default_strategy",):
+        raise ValueError(f"cannot shadow built-in strategy kernel {name!r}")
+    return _registry.register(STRATEGY_GROUP, name, params)
+
+
+def register_obs_kernel(name: str, params: Dict[str, float] | None = None):
+    """Decorator: make ``name`` selectable via the ``obs_plugins`` list."""
+    return _registry.register(OBS_GROUP, name, params)
+
+
+def _has(group: str, name: str) -> bool:
+    return name in _registry.available(group)
+
+
+def has_reward_kernel(name: str) -> bool:
+    return _has(REWARD_GROUP, name)
+
+
+def has_strategy_kernel(name: str) -> bool:
+    return _has(STRATEGY_GROUP, name)
+
+
+def has_obs_kernel(name: str) -> bool:
+    return _has(OBS_GROUP, name)
+
+
+def get_reward_kernel(name: str) -> Callable[..., Any]:
+    return _registry.get_plugin(REWARD_GROUP, name)
+
+
+def get_strategy_kernel(name: str) -> Callable[..., Any]:
+    return _registry.get_plugin(STRATEGY_GROUP, name)
+
+
+def get_obs_kernel(name: str) -> Callable[..., Any]:
+    return _registry.get_plugin(OBS_GROUP, name)
+
+
+def user_param_schema(
+    reward: str, strategy: str, obs_kernels: Tuple[str, ...] = ()
+) -> Dict[str, float]:
+    """Merged {config_key: default} for every selected custom kernel."""
+    schema: Dict[str, float] = {}
+    for group, name in ((REWARD_GROUP, reward), (STRATEGY_GROUP, strategy)):
+        if _has(group, name):
+            schema.update(_registry.get_plugin_params(group, name))
+    for name in obs_kernels:
+        if _has(OBS_GROUP, name):
+            schema.update(_registry.get_plugin_params(OBS_GROUP, name))
+    return schema

@@ -1,0 +1,247 @@
+"""Open kernel registry: third-party reward/strategy/obs kernels
+registered from OUTSIDE the package reach the jitted step and train
+(counterpart of the reference's arbitrary entry-point plugins called
+per step, reference app/plugin_loader.py:12-48, app/bt_bridge.py:191-201).
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from gymfx_tpu.plugins import kernels
+from tests.helpers import make_df, make_env, uptrend_df
+
+
+# --- third-party kernels, defined at import time in THIS test module ------
+@kernels.register_reward_kernel(
+    "test_asym_pnl", params={"loss_aversion": 2.0}
+)
+def _asym_pnl(state, cfg, params, active):
+    """Loss-averse pnl: losses weigh ``loss_aversion`` times gains."""
+    initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
+    r = (state.equity_delta - state.prev_equity_delta) / initial
+    r = jnp.where(r < 0, r * params.user["loss_aversion"], r)
+    return state, jnp.where(active, r * params.reward_scale, 0.0)
+
+
+@kernels.register_strategy_kernel(
+    "test_always_long", params={"test_units": 5.0}
+)
+def _always_long(state, a, o, h, l, c, mow, cfg, params, active):
+    """Enters a fixed long whenever flat, ignoring the action."""
+    submit = active & (state.pos == 0)
+    target = jnp.where(submit, params.user["test_units"], 0.0)
+    zero = jnp.zeros_like(state.pending_sl)
+    return state, (submit, target, zero, zero)
+
+
+@kernels.register_obs_kernel("test_bar_parity")
+def _bar_parity(state, data, cfg, params):
+    return {"bar_parity": (state.t % 2).astype(jnp.float32)[None]}
+
+
+def test_cannot_shadow_builtins():
+    with pytest.raises(ValueError, match="shadow"):
+        kernels.register_reward_kernel("pnl_reward")
+    with pytest.raises(ValueError, match="shadow"):
+        kernels.register_strategy_kernel("direct_atr_sltp")
+
+
+def test_unknown_kernel_still_rejected():
+    with pytest.raises(ValueError, match="unknown reward kernel"):
+        make_env(uptrend_df(), reward_plugin="nope_reward")
+
+
+def test_custom_reward_kernel_reaches_the_step():
+    df = uptrend_df(30)
+    env_sym = make_env(df, reward_plugin="pnl_reward", position_size=1000.0)
+    env_asym = make_env(
+        df, reward_plugin="test_asym_pnl", loss_aversion=3.0,
+        position_size=1000.0,
+    )
+    assert float(env_asym.params.user["loss_aversion"]) == 3.0
+
+    def run(env, actions):
+        s, _ = env.reset()
+        rs = []
+        for a in actions:
+            s, o, r, d, info = env.step(s, a)
+            rs.append(float(r))
+        return rs
+
+    # short an uptrend: losing steps -> custom reward is 3x the pnl reward
+    rs_sym = run(env_sym, [2, 0, 0, 0])
+    rs_asym = run(env_asym, [2, 0, 0, 0])
+    assert rs_sym[2] < 0
+    assert rs_asym[2] == pytest.approx(3.0 * rs_sym[2], rel=1e-5)
+    # winning steps match exactly
+    rs_sym_w = run(env_sym, [1, 0, 0, 0])
+    rs_asym_w = run(env_asym, [1, 0, 0, 0])
+    assert rs_sym_w[2] > 0
+    assert rs_asym_w[2] == pytest.approx(rs_sym_w[2], rel=1e-5)
+
+
+def test_custom_strategy_kernel_reaches_the_step():
+    df = uptrend_df(20)
+    env = make_env(df, strategy_plugin="test_always_long", test_units=7.0)
+    s, _ = env.reset()
+    for a in [0, 0, 0]:   # actions ignored by the custom kernel
+        s, o, r, d, info = env.step(s, a)
+    assert float(s.pos) == 7.0
+
+
+def test_custom_obs_kernel_adds_block():
+    env = make_env(uptrend_df(20), obs_plugins=["test_bar_parity"])
+    s, obs = env.reset()
+    assert "bar_parity" in obs
+    s, obs, *_ = env.step(s, 0)
+    s, obs, *_ = env.step(s, 0)
+    assert obs["bar_parity"].shape == (1,)
+
+
+def test_ppo_trains_with_custom_reward_kernel():
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    df = uptrend_df(120)
+    env = make_env(
+        df, reward_plugin="test_asym_pnl", loss_aversion=2.5,
+        num_envs=4,
+    )
+    config = dict(env.config, ppo_horizon=8, ppo_epochs=1, ppo_minibatches=2,
+                  num_envs=4, policy="mlp")
+    trainer = PPOTrainer(env, ppo_config_from(config))
+    state = trainer.init_state(0)
+    state, metrics = trainer.train_step(state)
+    assert np.isfinite(float(metrics["loss"]))
+    state, metrics = trainer.train_step(state)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_unknown_strategy_plugin_raises():
+    with pytest.raises(ValueError, match="unknown strategy kernel"):
+        make_env(uptrend_df(), strategy_plugin="my_momentum_typo")
+
+
+def test_custom_strategy_preserves_force_flat_audit():
+    """Overlay-forced flats must still hit the audit counters when a
+    registered strategy kernel is selected."""
+    from gymfx_tpu.core.types import EXEC_DIAG_INDEX
+
+    n = 20
+    closes = np.full(n, 1.1)
+    ev = np.zeros(n)
+    ev[3:] = 1.0  # event window opens at bar 3
+    df = make_df(closes, extra={"event_no_trade_window_active": ev})
+    env = make_env(
+        df, strategy_plugin="test_always_long", test_units=2.0,
+        event_context_execution_overlay=True, event_context_force_flat=True,
+    )
+    s, _ = env.reset()
+    for a in [0, 0, 0, 0, 0]:
+        s, o, r, d, info = env.step(s, a)
+    diag = np.asarray(s.exec_diag)
+    assert diag[EXEC_DIAG_INDEX["event_context_forced_flat_orders"]] >= 1
+    # the forced flat closed at least one kernel-opened trade
+    assert int(s.trade_count) >= 1
+
+
+def test_portfolio_partial_profiles_rejected(tmp_path):
+    import pandas as pd
+
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+    from gymfx_tpu.simulation.fixtures import default_profile
+
+    closes = np.full(16, 1.1)
+    for name in ("a", "b"):
+        pd.DataFrame({
+            "DATE_TIME": pd.date_range("2024-01-01", periods=16, freq="1min"),
+            "OPEN": closes, "HIGH": closes, "LOW": closes, "CLOSE": closes,
+            "VOLUME": 0.0,
+        }).to_csv(tmp_path / f"{name}.csv", index=False)
+    prof = {
+        k: getattr(default_profile(enforce_margin_preflight=False), k)
+        for k in default_profile().__dataclass_fields__
+    }
+    with pytest.raises(ValueError, match="every pair"):
+        PortfolioEnvironment({
+            "portfolio_files": {"EUR_USD": str(tmp_path / "a.csv"),
+                                "GBP_USD": str(tmp_path / "b.csv")},
+            "window_size": 4,
+            "portfolio_profiles": {"EUR_USD": prof},  # GBP left unbound
+        })
+
+
+def test_portfolio_without_agent_state_obs(tmp_path):
+    import pandas as pd
+
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+
+    closes = np.full(16, 1.1)
+    pd.DataFrame({
+        "DATE_TIME": pd.date_range("2024-01-01", periods=16, freq="1min"),
+        "OPEN": closes, "HIGH": closes, "LOW": closes, "CLOSE": closes,
+        "VOLUME": 0.0,
+    }).to_csv(tmp_path / "a.csv", index=False)
+    env = PortfolioEnvironment({
+        "portfolio_files": {"EUR_USD": str(tmp_path / "a.csv")},
+        "window_size": 4, "include_agent_state": False,
+    })
+    s, obs = env.reset()
+    assert "position" not in obs
+    assert "prices" in obs
+
+
+def test_portfolio_custom_obs_block_stays_per_pair(tmp_path):
+    import pandas as pd
+
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+
+    closes = np.full(16, 1.1)
+    for name in ("a", "b"):
+        pd.DataFrame({
+            "DATE_TIME": pd.date_range("2024-01-01", periods=16, freq="1min"),
+            "OPEN": closes, "HIGH": closes, "LOW": closes, "CLOSE": closes,
+            "VOLUME": 0.0,
+        }).to_csv(tmp_path / f"{name}.csv", index=False)
+    env = PortfolioEnvironment({
+        "portfolio_files": {"EUR_USD": str(tmp_path / "a.csv"),
+                            "GBP_USD": str(tmp_path / "b.csv")},
+        "window_size": 4, "obs_plugins": ["test_bar_parity"],
+    })
+    s, obs = env.reset()
+    # per-pair custom block keeps its (I, ...) shape, NOT collapsed to pair 0
+    assert obs["bar_parity"].shape == (2, 1)
+
+
+def test_cli_accepts_registered_kernel_names(tmp_path):
+    from gymfx_tpu.app.main import main
+
+    s = main([
+        "--input_data_file", "examples/data/eurusd_sample.csv",
+        "--driver_mode", "flat", "--steps", "20",
+        "--reward_plugin", "test_asym_pnl",
+        "--results_file", str(tmp_path / "r.json"), "--quiet_mode",
+    ])
+    assert s["total_return"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_custom_kernels_work_in_portfolio(tmp_path):
+    import pandas as pd
+
+    closes = 1.1 * (1.0 + 2e-4) ** np.arange(20)
+    df = pd.DataFrame({
+        "DATE_TIME": pd.date_range("2024-01-01", periods=20, freq="1min"),
+        "OPEN": closes, "HIGH": closes, "LOW": closes, "CLOSE": closes,
+        "VOLUME": 0.0,
+    })
+    p = tmp_path / "a.csv"
+    df.to_csv(p, index=False)
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+
+    env = PortfolioEnvironment({
+        "portfolio_files": {"EUR_USD": str(p)}, "window_size": 4,
+        "strategy_plugin": "test_always_long", "test_units": 3.0,
+    })
+    s, obs = env.reset()
+    for _ in range(3):
+        s, obs, r, d, info = env.step(s, np.zeros(1, np.int32))
+    assert np.asarray(s.pairs.pos).tolist() == [3.0]
