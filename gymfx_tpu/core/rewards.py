@@ -37,11 +37,11 @@ def compute_reward(
     if cfg.reward == "pnl_reward":
         return state, jnp.where(active, r_norm * params.reward_scale, 0.0)
 
-    if cfg.reward not in ("sharpe_reward", "dd_penalized_reward"):
+    from gymfx_tpu.plugins import kernels as _k
+
+    if cfg.reward not in _k.BUILTIN_REWARDS:
         # registered third-party kernel (plugins/kernels.py): traced
         # into the compiled step at this static branch
-        from gymfx_tpu.plugins import kernels as _k
-
         return _k.get_reward_kernel(cfg.reward)(state, cfg, params, active)
 
     if cfg.reward == "sharpe_reward":

@@ -342,7 +342,7 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         size_mode=str(config.get("size_mode", "fx_units")).lower(),
         atr_period=int(config.get("atr_period", 14)),
         reward=str(config.get("reward_plugin", "pnl_reward")),
-        obs_kernels=tuple(config.get("obs_plugins") or ()),
+        obs_kernels=_obs_kernel_names(config.get("obs_plugins")),
         sharpe_window=int(config.get("window", config.get("sharpe_window", 64))),
         stage_b_force_close_reward_penalty=bool(
             config.get("stage_b_force_close_reward_penalty", False)
@@ -354,6 +354,16 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         financing_enabled=financing,
         dtype=dtype,
     )
+
+
+def _obs_kernel_names(raw: Any) -> Tuple[str, ...]:
+    """obs_plugins accepts a list OR the CLI's comma-separated string —
+    tuple() on a bare string would split it into characters."""
+    if not raw:
+        return ()
+    if isinstance(raw, str):
+        return tuple(s.strip() for s in raw.split(",") if s.strip())
+    return tuple(str(s) for s in raw)
 
 
 def _strategy_kernel_name(config: Dict[str, Any]) -> str:

@@ -9,13 +9,20 @@ while accumulating attention with the online-softmax recurrence
 holds its own Q block and one K/V block: memory O(S/P), communication
 riding ICI neighbor links, result exact (not approximate).
 
-Layout: (seq, heads, head_dim), sequence sharded over ``axis``.
+Two entry points:
+  ring_attention        (S, H, D) global view, wraps its own shard_map —
+                        the standalone capability (used by the dryrun).
+  ring_attention_inner  per-shard blocks (..., S/P, H, D) with optional
+                        leading batch dims, for use INSIDE an existing
+                        shard_map — this is what the transformer_ring
+                        policy calls so a whole batched policy forward
+                        can be sequence-sharded (train/policies.py).
+
 Causal masking uses global positions reconstructed from the ring
 rotation, so it is exact across shards.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -24,20 +31,65 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def _block_attention(q, k, v, m, l, acc, scale, mask):
-    """One online-softmax accumulation step.
+    """One online-softmax accumulation step (leading batch dims allowed).
 
-    q: (Sq, H, D); k/v: (Sk, H, D); m/l: (H, Sq); acc: (Sq, H, D);
-    mask: (Sq, Sk) additive (-inf for masked) or None.
+    q: (..., Sq, H, D); k/v: (..., Sk, H, D); m/l: (..., H, Sq);
+    acc: (..., Sq, H, D); mask: (Sq, Sk) additive (-inf masked) or None.
     """
-    scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    scores = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
     if mask is not None:
         scores = scores + mask[None, :, :]
     m_new = jnp.maximum(m, scores.max(axis=-1))
     p_ = jnp.exp(scores - m_new[..., None])
-    corr = jnp.exp(m - m_new)
+    corr = jnp.exp(m - m_new)                      # (..., H, Sq)
     l_new = l * corr + p_.sum(axis=-1)
-    acc_new = acc * corr.T[..., None] + jnp.einsum("hqk,khd->qhd", p_, v)
+    corr_q = jnp.swapaxes(corr, -1, -2)            # (..., Sq, H)
+    acc_new = acc * corr_q[..., None] + jnp.einsum("...hqk,...khd->...qhd", p_, v)
     return m_new, l_new, acc_new
+
+
+def ring_attention_inner(
+    q_blk, k_blk, v_blk, *, axis: str, n_shards: int, causal: bool = False
+):
+    """Exact attention on per-shard blocks inside an active shard_map.
+
+    q/k/v blocks: (..., S/P, H, D) — the local sequence slice, any
+    leading batch dims.  ``axis`` must be a mesh axis currently in
+    scope; ``n_shards`` its (static) size.  Streams K/V around the ring
+    with ``ppermute``; returns the local (..., S/P, H, D) output block.
+    """
+    *batch, sb, h, d = q_blk.shape
+    scale = 1.0 / (d ** 0.5)
+    my = jax.lax.axis_index(axis)
+
+    def body(i, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # the K/V block currently held originated on shard (my - i) % P
+        src = (my - i) % n_shards
+        if causal:
+            q_pos = my * sb + jnp.arange(sb)
+            k_pos = src * sb + jnp.arange(sb)
+            mask = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf)
+        else:
+            mask = None
+        m, l, acc = _block_attention(q_blk, k_cur, v_cur, m, l, acc, scale, mask)
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_next = jax.lax.ppermute(k_cur, axis, perm)
+        v_next = jax.lax.ppermute(v_cur, axis, perm)
+        return (k_next, v_next, m, l, acc)
+
+    # mark the accumulators as device-varying over the ring axis so the
+    # fori_loop carry type matches after the first iteration
+    m0 = jax.lax.pcast(
+        jnp.full((*batch, h, sb), -jnp.inf, q_blk.dtype), axis, to="varying"
+    )
+    l0 = jax.lax.pcast(jnp.zeros((*batch, h, sb), q_blk.dtype), axis, to="varying")
+    acc0 = jnp.zeros_like(q_blk)
+    _, _, m, l, acc = jax.lax.fori_loop(
+        0, n_shards, body, (k_blk, v_blk, m0, l0, acc0)
+    )
+    denom = jnp.swapaxes(jnp.maximum(l, 1e-30), -1, -2)  # (..., S/P, H)
+    return acc / denom[..., None]
 
 
 def ring_attention(
@@ -52,41 +104,11 @@ def ring_attention(
     p = mesh.shape[axis]
     if s % p != 0:
         raise ValueError(f"sequence length {s} must divide mesh axis {axis}={p}")
-    sb = s // p
-    scale = 1.0 / (d ** 0.5)
 
     def shard_fn(q_blk, k_blk, v_blk):
-        my = jax.lax.axis_index(axis)
-
-        def body(i, carry):
-            k_cur, v_cur, m, l, acc = carry
-            # the K/V block currently held originated on shard (my - i) % p
-            src = (my - i) % p
-            if causal:
-                q_pos = my * sb + jnp.arange(sb)
-                k_pos = src * sb + jnp.arange(sb)
-                mask = jnp.where(
-                    q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf
-                )
-            else:
-                mask = None
-            m, l, acc = _block_attention(q_blk, k_cur, v_cur, m, l, acc, scale, mask)
-            perm = [(j, (j + 1) % p) for j in range(p)]
-            k_next = jax.lax.ppermute(k_cur, axis, perm)
-            v_next = jax.lax.ppermute(v_cur, axis, perm)
-            return (k_next, v_next, m, l, acc)
-
-        # mark the accumulators as device-varying over the ring axis so
-        # the fori_loop carry type matches after the first iteration
-        m0 = jax.lax.pcast(
-            jnp.full((h, sb), -jnp.inf, q_blk.dtype), axis, to="varying"
+        return ring_attention_inner(
+            q_blk, k_blk, v_blk, axis=axis, n_shards=p, causal=causal
         )
-        l0 = jax.lax.pcast(jnp.zeros((h, sb), q_blk.dtype), axis, to="varying")
-        acc0 = jnp.zeros_like(q_blk)
-        _, _, m, l, acc = jax.lax.fori_loop(
-            0, p, body, (k_blk, v_blk, m0, l0, acc0)
-        )
-        return acc / jnp.maximum(l, 1e-30).T[..., None]
 
     spec = P(axis, None, None)
     fn = jax.shard_map(
@@ -96,12 +118,14 @@ def ring_attention(
 
 
 def full_attention(q, k, v, *, causal: bool = False):
-    """Single-device reference implementation (parity oracle)."""
-    s, h, d = q.shape
-    scores = jnp.einsum("qhd,khd->hqk", q, k) / (d ** 0.5)
+    """Single-device reference implementation (parity oracle);
+    leading batch dims allowed."""
+    d = q.shape[-1]
+    s = q.shape[-3]
+    scores = jnp.einsum("...qhd,...khd->...hqk", q, k) / (d ** 0.5)
     if causal:
         pos = jnp.arange(s)
         mask = jnp.where(pos[:, None] >= pos[None, :], 0.0, -jnp.inf)
         scores = scores + mask[None, :, :]
     weights = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("hqk,khd->qhd", weights, v)
+    return jnp.einsum("...hqk,...khd->...qhd", weights, v)

@@ -94,12 +94,21 @@ def get_obs_kernel(name: str) -> Callable[..., Any]:
 def user_param_schema(
     reward: str, strategy: str, obs_kernels: Tuple[str, ...] = ()
 ) -> Dict[str, float]:
-    """Merged {config_key: default} for every selected custom kernel."""
+    """Merged {config_key: default} for every selected custom kernel.
+    Conflicting defaults for the same key raise — the kernels would
+    silently read each other's value otherwise."""
     schema: Dict[str, float] = {}
-    for group, name in ((REWARD_GROUP, reward), (STRATEGY_GROUP, strategy)):
-        if _has(group, name):
-            schema.update(_registry.get_plugin_params(group, name))
-    for name in obs_kernels:
-        if _has(OBS_GROUP, name):
-            schema.update(_registry.get_plugin_params(OBS_GROUP, name))
+    selected = [(REWARD_GROUP, reward), (STRATEGY_GROUP, strategy)]
+    selected += [(OBS_GROUP, name) for name in obs_kernels]
+    for group, name in selected:
+        if not _has(group, name):
+            continue
+        for key, default in _registry.get_plugin_params(group, name).items():
+            if key in schema and schema[key] != default:
+                raise ValueError(
+                    f"kernel parameter key {key!r} declared by multiple "
+                    f"selected kernels with conflicting defaults "
+                    f"({schema[key]!r} vs {default!r} from {name!r})"
+                )
+            schema[key] = default
     return schema

@@ -91,8 +91,12 @@ class ImpalaTrainer:
         self.env = env
         self.icfg = icfg
         self.mesh = mesh
+        kwargs = dict(icfg.policy_kwargs)
+        if icfg.policy == "transformer_ring":
+            # global window for the ring policy's positional embeddings
+            kwargs.setdefault("window", env.cfg.window_size)
         self.policy = make_policy(
-            icfg.policy, dtype=icfg.policy_dtype, **dict(icfg.policy_kwargs)
+            icfg.policy, dtype=icfg.policy_dtype, **kwargs
         )
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(icfg.max_grad_norm),
@@ -100,7 +104,7 @@ class ImpalaTrainer:
         )
         cfg, params, data = env.cfg, env.params, env.data
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
-        self._is_transformer = icfg.policy == "transformer"
+        self._is_transformer = icfg.policy in ("transformer", "transformer_ring")
         self._window = cfg.window_size
         self._reset_vec = self._encode(reset_obs)
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)

@@ -134,6 +134,135 @@ class TransformerPolicy(nn.Module):
         return logits, value, carry
 
 
+class RingTransformerPolicy(nn.Module):
+    """Transformer whose attention can run sequence-parallel ring
+    attention over a 'seq' mesh axis (BASELINE config 5 long-context
+    path; parallel/ring_attention.py).
+
+    Two modes, SAME parameter structure:
+      * ``seq_axis=None`` (default): ordinary full attention over the
+        whole window — how the policy initializes and trains on one
+        device;
+      * ``seq_axis='seq', seq_shards=P``: the instance is being applied
+        INSIDE a shard_map whose token axis is sharded over that mesh
+        axis; attention streams K/V blocks around the ring and the
+        outputs are numerically identical (up to fp error) to the
+        unsharded forward with the same params.
+
+    Use ``seq_sharded_forward`` to run the sharded mode; the ``window``
+    field must be the GLOBAL token count (positional embeddings are
+    sliced per shard by ring position).
+    """
+
+    n_actions: int = 3
+    window: int = 32
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+    seq_shards: int = 1
+
+    @nn.compact
+    def __call__(self, tokens):
+        from gymfx_tpu.parallel.ring_attention import (
+            full_attention,
+            ring_attention_inner,
+        )
+
+        head_dim = self.d_model // self.n_heads
+        x = nn.Dense(self.d_model, dtype=self.dtype)(tokens.astype(self.dtype))
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.window, self.d_model), jnp.float32,
+        )
+        if self.seq_axis is not None:
+            sb = self.window // self.seq_shards
+            idx = jax.lax.axis_index(self.seq_axis)
+            pos_local = jax.lax.dynamic_slice_in_dim(pos, idx * sb, sb, 0)
+        else:
+            pos_local = pos
+        x = x + pos_local.astype(self.dtype)
+
+        for _ in range(self.n_layers):
+            y = nn.LayerNorm(dtype=self.dtype)(x)
+            q = nn.DenseGeneral((self.n_heads, head_dim), dtype=self.dtype)(y)
+            k = nn.DenseGeneral((self.n_heads, head_dim), dtype=self.dtype)(y)
+            v = nn.DenseGeneral((self.n_heads, head_dim), dtype=self.dtype)(y)
+            if self.seq_axis is not None:
+                a = ring_attention_inner(
+                    q, k, v, axis=self.seq_axis, n_shards=self.seq_shards
+                )
+            else:
+                a = full_attention(q, k, v)
+            y = nn.DenseGeneral(
+                self.d_model, axis=(-2, -1), dtype=self.dtype
+            )(a)
+            x = x + y
+            y = nn.LayerNorm(dtype=self.dtype)(x)
+            y = nn.Dense(self.d_model * 4, dtype=self.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(self.d_model, dtype=self.dtype)(y)
+            x = x + y
+
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        pooled = jnp.mean(x, axis=-2)
+        if self.seq_axis is not None:
+            # equal block sizes: the global mean is the pmean of block
+            # means, and the result is replicated across the ring
+            pooled = jax.lax.pmean(pooled, self.seq_axis)
+        logits = nn.Dense(self.n_actions, dtype=jnp.float32)(pooled)
+        value = nn.Dense(1, dtype=jnp.float32)(pooled)
+        return logits, jnp.squeeze(value, axis=-1)
+
+    def initial_carry(self, batch_shape=()):
+        return ()
+
+    def apply_seq(self, params, tokens, carry):
+        logits, value = self.apply(params, tokens)
+        return logits, value, carry
+
+def with_seq_sharding(
+    policy: RingTransformerPolicy, axis: str, shards: int
+) -> "RingTransformerPolicy":
+    """Same hyperparams/param structure, sharded-attention mode.  A free
+    function (not a method): flax would treat a module constructed
+    inside a module method as a child submodule."""
+    if policy.window % shards != 0:
+        raise ValueError(
+            f"window {policy.window} must divide seq shards {shards}"
+        )
+    return RingTransformerPolicy(
+        n_actions=policy.n_actions, window=policy.window,
+        d_model=policy.d_model, n_heads=policy.n_heads,
+        n_layers=policy.n_layers, dtype=policy.dtype,
+        seq_axis=axis, seq_shards=shards,
+    )
+
+
+def seq_sharded_forward(policy: RingTransformerPolicy, params, tokens,
+                        mesh, axis: str = "seq"):
+    """Apply a RingTransformerPolicy with the WINDOW sharded over
+    ``mesh[axis]``: tokens (..., window, token_dim) enter with their
+    token axis split across devices; attention runs as a ring; the
+    pooled logits/value come back replicated.  Batch dims stay
+    unsharded (shard other mesh axes outside if desired)."""
+    shards = mesh.shape[axis]
+    sharded = with_seq_sharding(policy, axis, shards)
+    nbatch = tokens.ndim - 2
+    tok_spec = jax.sharding.PartitionSpec(*([None] * nbatch), axis, None)
+    out_spec = jax.sharding.PartitionSpec(*([None] * nbatch))
+
+    def f(tok_blk):
+        return sharded.apply(params, tok_blk)
+
+    fn = jax.shard_map(
+        f, mesh=mesh, in_specs=(tok_spec,),
+        out_specs=(out_spec, out_spec),
+    )
+    return fn(tokens)
+
+
 def tokens_from_obs(obs: Dict[str, Any], window: int) -> Any:
     """Obs dict -> (window, token_dim) token sequence for the
     TransformerPolicy: window-aligned blocks become per-bar token
@@ -185,4 +314,6 @@ def make_policy(name: str, n_actions: int = 3, dtype: Any = jnp.float32, **kw):
         return LSTMPolicy(n_actions=n_actions, dtype=dtype, **kw)
     if name == "transformer":
         return TransformerPolicy(n_actions=n_actions, dtype=dtype, **kw)
+    if name == "transformer_ring":
+        return RingTransformerPolicy(n_actions=n_actions, dtype=dtype, **kw)
     raise ValueError(f"unknown policy {name!r}")

@@ -114,14 +114,19 @@ class PPOTrainer:
                 **dict(pcfg.policy_kwargs)
             )
         else:
+            kwargs = dict(pcfg.policy_kwargs)
+            if pcfg.policy == "transformer_ring":
+                # the ring policy needs the GLOBAL window for positional
+                # embeddings (sliced per shard under seq sharding)
+                kwargs.setdefault("window", env.cfg.window_size)
             self.policy = make_policy(
-                pcfg.policy, dtype=pcfg.policy_dtype, **dict(pcfg.policy_kwargs)
+                pcfg.policy, dtype=pcfg.policy_dtype, **kwargs
             )
         self.optimizer = self._make_optimizer()
 
         cfg, params, data = env.cfg, env.params, env.data
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
-        self._is_transformer = pcfg.policy == "transformer"
+        self._is_transformer = pcfg.policy in ("transformer", "transformer_ring")
         self._window = cfg.window_size
         self._reset_vec = self._encode(reset_obs)
         self.obs_dim = self._reset_vec.shape

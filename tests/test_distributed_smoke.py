@@ -1,0 +1,102 @@
+"""Multi-PROCESS distributed smoke: two OS processes join one JAX
+cluster through ``initialize_distributed`` (parallel/mesh.py), build a
+shared 4-device mesh (2 local CPU devices each), and run one sharded
+SGD step over a globally-sharded batch — the gradient all-reduce
+crosses the process boundary (the DCN path of SURVEY.md §5.8).  Both
+processes must agree with the single-process reference."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import json, os, sys
+pid = int(sys.argv[1]); coord = sys.argv[2]
+import jax
+
+# sitecustomize may force-register a remote accelerator plugin that
+# overrides JAX_PLATFORMS (see bench.py); pin the platform explicitly
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gymfx_tpu.parallel.mesh import initialize_distributed, make_mesh
+
+initialize_distributed(coord, 2, pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, jax.devices()
+
+mesh = make_mesh({"data": 4})
+xsh = NamedSharding(mesh, P("data"))
+
+X = np.arange(16, dtype=np.float32).reshape(8, 2) / 16.0
+Y = np.arange(8, dtype=np.float32) / 8.0
+x = jax.make_array_from_callback((8, 2), xsh, lambda idx: X[idx])
+y = jax.make_array_from_callback((8,), NamedSharding(mesh, P("data")),
+                                 lambda idx: Y[idx])
+
+@jax.jit
+def sgd_step(w, x, y):
+    def loss(w):
+        return jnp.mean((x @ w - y) ** 2)
+    return w - 0.1 * jax.grad(loss)(w)
+
+w1 = sgd_step(jnp.zeros((2,)), x, y)  # grad all-reduce spans processes
+print("RESULT " + json.dumps(np.asarray(jax.device_get(w1)).tolist()),
+      flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_sgd_step(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    # must be set before interpreter start: sitecustomize imports jax
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_ENABLE_COMPILATION_CACHE"] = "false"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            cwd=os.getcwd(), text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed worker timed out")
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(out)
+
+    results = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"no RESULT line in worker output: {out[-500:]}"
+        results.append(np.asarray(json.loads(lines[0][len("RESULT "):])))
+
+    # both processes hold the same replicated update...
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+    # ...equal to the single-process reference
+    X = np.arange(16, dtype=np.float32).reshape(8, 2) / 16.0
+    Y = np.arange(8, dtype=np.float32) / 8.0
+    grad = 2.0 * X.T @ (X @ np.zeros(2) - Y) / 8.0
+    np.testing.assert_allclose(results[0], -0.1 * grad, rtol=1e-5)

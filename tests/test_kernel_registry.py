@@ -212,6 +212,26 @@ def test_portfolio_custom_obs_block_stays_per_pair(tmp_path):
     assert obs["bar_parity"].shape == (2, 1)
 
 
+def test_obs_plugins_accepts_cli_string_form():
+    env = make_env(uptrend_df(20), obs_plugins="test_bar_parity")
+    s, obs = env.reset()
+    assert "bar_parity" in obs
+
+
+def test_conflicting_kernel_param_defaults_raise():
+    @kernels.register_reward_kernel("test_conf_r", params={"shared_k": 1.0})
+    def _r(state, cfg, params, active):
+        return state, jnp.zeros_like(state.equity_delta)
+
+    @kernels.register_strategy_kernel("test_conf_s", params={"shared_k": 2.0})
+    def _s(state, a, o, h, l, c, mow, cfg, params, active):
+        zero = jnp.zeros_like(state.pending_sl)
+        return state, (jnp.zeros_like(active), zero, zero, zero)
+
+    with pytest.raises(ValueError, match="conflicting defaults"):
+        kernels.user_param_schema("test_conf_r", "test_conf_s")
+
+
 def test_cli_accepts_registered_kernel_names(tmp_path):
     from gymfx_tpu.app.main import main
 
