@@ -9,42 +9,51 @@ from typing import Any, Dict, Iterable, Mapping, Optional
 
 
 def process_unknown_args(unknown_args: Iterable[str]) -> Dict[str, Any]:
-    """Turn leftover ``--key value`` / ``--flag`` CLI tokens into a dict."""
-    args = list(unknown_args)
+    """Turn leftover ``--key value`` / ``--flag`` CLI tokens into a dict.
+
+    A ``--key`` immediately followed by a non-flag token takes that
+    token as its value; a ``--key`` followed by another flag (or by
+    nothing) is a boolean switch.  Stray positional tokens with no
+    preceding flag are ignored.  Semantics pinned by tests/test_config.py
+    (reference behavior: app/config_merger.py unknown-arg passthrough).
+    """
     parsed: Dict[str, Any] = {}
-    i = 0
-    while i < len(args):
-        key = args[i]
-        if not key.startswith("--"):
-            i += 1
-            continue
-        if i + 1 < len(args) and not args[i + 1].startswith("--"):
-            parsed[key.lstrip("-")] = args[i + 1]
-            i += 2
-        else:
-            parsed[key.lstrip("-")] = True
-            i += 1
+    pending: Optional[str] = None  # flag still waiting for its value
+    for token in unknown_args:
+        if token.startswith("--"):
+            if pending is not None:
+                parsed[pending] = True
+            pending = token.lstrip("-")
+        elif pending is not None:
+            parsed[pending] = token
+            pending = None
+    if pending is not None:
+        parsed[pending] = True
     return parsed
 
 
+_LITERAL_VALUES: Dict[str, Any] = {
+    "true": True,
+    "false": False,
+    "none": None,
+    "null": None,
+}
+
+
 def convert_type(value: Any) -> Any:
-    """Coerce CLI string values: bool / None / int / float / str."""
-    if isinstance(value, bool):
-        return value
+    """Coerce CLI string values: literal bool/None, else the narrowest
+    of int -> float -> str.  Non-strings pass through untouched."""
     if not isinstance(value, str):
         return value
     lowered = value.strip().lower()
-    if lowered in {"true", "false"}:
-        return lowered == "true"
-    if lowered in {"none", "null"}:
-        return None
-    try:
-        return int(value)
-    except ValueError:
+    if lowered in _LITERAL_VALUES:
+        return _LITERAL_VALUES[lowered]
+    for parse in (int, float):
         try:
-            return float(value)
+            return parse(value)
         except ValueError:
-            return value
+            continue
+    return value
 
 
 def merge_config(

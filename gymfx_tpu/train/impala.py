@@ -32,7 +32,13 @@ import optax
 from gymfx_tpu.core import env as env_core
 from gymfx_tpu.core.runtime import Environment
 from gymfx_tpu.train.common import masked_reset
-from gymfx_tpu.train.policies import flatten_obs, make_policy, tokens_from_obs
+from gymfx_tpu.train.policies import (
+    flatten_obs,
+    is_token_policy,
+    make_policy,
+    policy_kwargs_for,
+    tokens_from_obs,
+)
 
 
 class ImpalaConfig(NamedTuple):
@@ -91,12 +97,11 @@ class ImpalaTrainer:
         self.env = env
         self.icfg = icfg
         self.mesh = mesh
-        kwargs = dict(icfg.policy_kwargs)
-        if icfg.policy == "transformer_ring":
-            # global window for the ring policy's positional embeddings
-            kwargs.setdefault("window", env.cfg.window_size)
         self.policy = make_policy(
-            icfg.policy, dtype=icfg.policy_dtype, **kwargs
+            icfg.policy, dtype=icfg.policy_dtype,
+            **policy_kwargs_for(
+                icfg.policy, dict(icfg.policy_kwargs), env.cfg.window_size
+            ),
         )
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(icfg.max_grad_norm),
@@ -104,7 +109,7 @@ class ImpalaTrainer:
         )
         cfg, params, data = env.cfg, env.params, env.data
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
-        self._is_transformer = icfg.policy in ("transformer", "transformer_ring")
+        self._is_transformer = is_token_policy(icfg.policy)
         self._window = cfg.window_size
         self._reset_vec = self._encode(reset_obs)
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)

@@ -230,7 +230,7 @@ def with_seq_sharding(
     inside a module method as a child submodule."""
     if policy.window % shards != 0:
         raise ValueError(
-            f"window {policy.window} must divide seq shards {shards}"
+            f"seq shard count {shards} must divide window {policy.window}"
         )
     return RingTransformerPolicy(
         n_actions=policy.n_actions, window=policy.window,
@@ -303,6 +303,24 @@ class ContinuousMLPPolicy(nn.Module):
     def apply_seq(self, params, x, carry):
         dist, value = self.apply(params, x)
         return dist, value, carry
+
+
+# policies whose inputs are (window, token_dim) token sequences rather
+# than flat vectors — shared by every trainer's encode/init paths
+TOKEN_POLICIES = ("transformer", "transformer_ring")
+
+
+def is_token_policy(name: str) -> bool:
+    return name in TOKEN_POLICIES
+
+
+def policy_kwargs_for(name: str, kwargs: Dict[str, Any], window: int) -> Dict[str, Any]:
+    """Trainer-side kwarg resolution: the ring policy needs the GLOBAL
+    window for its positional embeddings (sliced per shard)."""
+    kwargs = dict(kwargs)
+    if name == "transformer_ring":
+        kwargs.setdefault("window", window)
+    return kwargs
 
 
 def make_policy(name: str, n_actions: int = 3, dtype: Any = jnp.float32, **kw):

@@ -30,7 +30,9 @@ from gymfx_tpu.core.runtime import Environment
 from gymfx_tpu.train.common import masked_reset
 from gymfx_tpu.train.policies import (
     flatten_obs,
+    is_token_policy,
     make_policy,
+    policy_kwargs_for,
     tokens_from_obs,
 )
 
@@ -114,19 +116,17 @@ class PPOTrainer:
                 **dict(pcfg.policy_kwargs)
             )
         else:
-            kwargs = dict(pcfg.policy_kwargs)
-            if pcfg.policy == "transformer_ring":
-                # the ring policy needs the GLOBAL window for positional
-                # embeddings (sliced per shard under seq sharding)
-                kwargs.setdefault("window", env.cfg.window_size)
             self.policy = make_policy(
-                pcfg.policy, dtype=pcfg.policy_dtype, **kwargs
+                pcfg.policy, dtype=pcfg.policy_dtype,
+                **policy_kwargs_for(
+                    pcfg.policy, dict(pcfg.policy_kwargs), env.cfg.window_size
+                ),
             )
         self.optimizer = self._make_optimizer()
 
         cfg, params, data = env.cfg, env.params, env.data
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
-        self._is_transformer = pcfg.policy in ("transformer", "transformer_ring")
+        self._is_transformer = is_token_policy(pcfg.policy)
         self._window = cfg.window_size
         self._reset_vec = self._encode(reset_obs)
         self.obs_dim = self._reset_vec.shape

@@ -77,15 +77,20 @@ def test_two_process_distributed_sgd_step(tmp_path):
         for pid in (0, 1)
     ]
     outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                pytest.fail("distributed worker timed out")
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(out)
+    finally:
+        # a failed worker must not leave its peer blocked on the
+        # coordination barrier holding the port
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            pytest.fail("distributed worker timed out")
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(out)
 
     results = []
     for out in outs:

@@ -50,9 +50,36 @@ def test_seq_sharded_forward_matches_single_device():
 
 @pytest.mark.skipif(N_DEV < 2, reason="needs a multi-device (CPU) mesh")
 def test_batched_ring_attention_inner_matches_full():
-    # the batched (inside-shard_map) path against the batched oracle
+    """ring_attention_inner with LEADING BATCH DIMS, called inside an
+    explicit shard_map, against the batched full-attention oracle."""
+    from jax.sharding import PartitionSpec as P
+
+    from gymfx_tpu.parallel.ring_attention import ring_attention_inner
+
     window = 4 * N_DEV
+    batch = 3
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (batch, window, 2, 8)) for kk in ks)
+    mesh = make_mesh({"seq": N_DEV})
+    spec = P(None, "seq", None, None)
+
+    def f(qb, kb, vb):
+        return ring_attention_inner(
+            qb, kb, vb, axis="seq", n_shards=N_DEV, causal=True
+        )
+
+    out = jax.shard_map(
+        f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+    ref = full_attention(q, k, v, causal=True)
+    assert out.shape == ref.shape
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs a multi-device (CPU) mesh")
+def test_unbatched_ring_attention_matches_full():
+    window = 4 * N_DEV
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
     q, k, v = (jax.random.normal(kk, (window, 2, 8)) for kk in ks)
     mesh = make_mesh({"seq": N_DEV})
     out = ring_attention(q, k, v, mesh=mesh, axis="seq", causal=True)
