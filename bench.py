@@ -83,6 +83,9 @@ def main() -> None:
         ppo_epochs=1,
         ppo_minibatches=4,
         policy="mlp",
+        # policy compute in bfloat16 (MXU-native; params/updates stay
+        # f32) — measured ~10% faster than f32 at identical loss curves
+        policy_dtype="bfloat16",
         window_size=32,
     )
     env = Environment(config)
@@ -106,7 +109,7 @@ def main() -> None:
             {
                 "metric": "ppo_env_steps_per_sec_per_chip",
                 "value": round(steps_per_sec, 1),
-                "unit": "env steps/sec/chip (PPO MLP, fused rollout+update)",
+                "unit": "env steps/sec/chip (PPO MLP bf16 policy, fused rollout+update)",
                 "vs_baseline": round(steps_per_sec / baseline_per_chip, 3),
             }
         )

@@ -264,6 +264,10 @@ class PPOTrainer:
             out = dict(
                 obs=obs_vec, action=action, logp=logp, value=value,
                 reward=reward.astype(jnp.float32), done=done,
+                # the carry that ENTERED this step — replayed during the
+                # minibatch passes so recurrent policies see exactly the
+                # state they acted with (stored-state recurrent replay)
+                pcarry=pcarry,
             )
             return (env_states2, obs_vec2, pcarry2, rng), out
 
@@ -343,14 +347,15 @@ class PPOTrainer:
             "adv": advs.reshape(n_total),
             "ret": returns.reshape(n_total),
         }
-        # Recurrent PPO simplification: minibatches see a zero carry (the
-        # stored rollout logp was computed with the live carry) — the
-        # standard shortcut in short-horizon PPO-LSTM variants.  Proper
-        # long-recurrence credit assignment belongs to an off-policy
-        # IMPALA-style learner with stored carries.
-        carry0 = self.policy.initial_carry(())
+        # Stored-state recurrent replay: each step replays with the carry
+        # it was collected under (R2D2-style stored state), so at the
+        # first epoch the replayed log-probs equal the stored ones
+        # exactly (ratio == 1) — no zero-carry approximation.  Carries
+        # go stale across epochs as params move, the standard stored-
+        # state trade-off; IMPALA re-unrolls from scratch instead
+        # (train/impala.py).
         flat["pcarry"] = jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (n_total, *x.shape)), carry0
+            lambda x: x.reshape(n_total, *x.shape[2:]), traj["pcarry"]
         )
 
         params, opt_state = state.params, state.opt_state

@@ -302,3 +302,39 @@ def test_continuous_rejects_non_mlp_policy():
     with pytest.raises(ValueError, match="continuous action"):
         _trainer(action_space_mode="continuous", policy="lstm",
                  policy_kwargs={})
+
+
+def test_ppo_lstm_stored_state_replay_is_exact():
+    """Minibatch replay must see the carry each step was collected
+    under: with unchanged params the replayed log-probs equal the
+    stored rollout log-probs exactly (ratio == 1), not a zero-carry
+    approximation."""
+    import jax
+    import jax.numpy as jnp
+
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+    from tests.helpers import make_env, uptrend_df
+
+    env = make_env(uptrend_df(200), window_size=8, num_envs=4)
+    config = dict(env.config, ppo_horizon=8, ppo_epochs=1, ppo_minibatches=2,
+                  num_envs=4, policy="lstm")
+    tr = PPOTrainer(env, ppo_config_from(config))
+    state = tr.init_state(0)
+    _, _, _, _, traj, _ = tr._rollout(
+        state.params, state.env_states, state.obs_vec, state.policy_carry,
+        state.rng,
+    )
+    n_total = 8 * 4
+    obs = traj["obs"].reshape(n_total, *traj["obs"].shape[2:])
+    carries = jax.tree.map(
+        lambda x: x.reshape(n_total, *x.shape[2:]), traj["pcarry"]
+    )
+    logits, _, _ = jax.vmap(tr._policy_forward, in_axes=(None, 0, 0))(
+        state.params, obs, carries
+    )
+    replay_logp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits),
+        traj["action"].reshape(n_total)[:, None], axis=1,
+    )[:, 0]
+    stored_logp = traj["logp"].reshape(n_total)
+    assert float(jnp.max(jnp.abs(replay_logp - stored_logp))) < 1e-6
