@@ -86,3 +86,33 @@ def test_instrument_spec_id():
         margin_maint=0.02,
     )
     assert spec.instrument_id == "EUR/USD.SIM"
+
+
+def test_example_profiles_load_and_bind():
+    """The shipped example profiles (counterparts of the reference's
+    examples/config/execution_cost_profiles/) parse and bind."""
+    from gymfx_tpu.contracts import load_execution_cost_profile
+
+    pess = load_execution_cost_profile(
+        "examples/configs/execution_cost_profiles/pessimistic_v1.json"
+    )
+    assert pess.limit_fill_policy == "conservative"
+    assert pess.financing_enabled
+    legacy = load_execution_cost_profile(
+        "examples/configs/execution_cost_profiles/legacy_v1.json"
+    )
+    assert legacy.limit_fill_policy == "touch"
+    assert legacy.intrabar_collision_policy == "ohlc"
+
+
+def test_financed_profile_example_config_runs(tmp_path):
+    import json
+
+    from gymfx_tpu.app.main import main
+
+    summary = main([
+        "--load_config", "examples/configs/inference_financed_profile.json",
+        "--steps", "60",
+        "--results_file", str(tmp_path / "r.json"), "--quiet_mode",
+    ])
+    assert "final_equity" in summary
