@@ -134,10 +134,11 @@ class TransformerPolicy(nn.Module):
         return logits, value, carry
 
 
-class RingTransformerPolicy(nn.Module):
-    """Transformer whose attention can run sequence-parallel ring
-    attention over a 'seq' mesh axis (BASELINE config 5 long-context
-    path; parallel/ring_attention.py).
+class RingTransformerEncoder(nn.Module):
+    """Transformer trunk whose attention can run sequence-parallel ring
+    attention over a 'seq' mesh axis (parallel/ring_attention.py);
+    returns the pooled (..., d_model) embedding.  Shared by the
+    single-pair and portfolio ring policies.
 
     Two modes, SAME parameter structure:
       * ``seq_axis=None`` (default): ordinary full attention over the
@@ -154,7 +155,6 @@ class RingTransformerPolicy(nn.Module):
     sliced per shard by ring position).
     """
 
-    n_actions: int = 3
     window: int = 32
     d_model: int = 128
     n_heads: int = 4
@@ -211,6 +211,30 @@ class RingTransformerPolicy(nn.Module):
             # equal block sizes: the global mean is the pmean of block
             # means, and the result is replicated across the ring
             pooled = jax.lax.pmean(pooled, self.seq_axis)
+        return pooled
+
+
+class RingTransformerPolicy(nn.Module):
+    """Actor-critic over RingTransformerEncoder (BASELINE config 5
+    long-context path).  Use ``seq_sharded_forward`` for the
+    sequence-sharded mode; same parameter structure in both modes."""
+
+    n_actions: int = 3
+    window: int = 32
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+    seq_shards: int = 1
+
+    @nn.compact
+    def __call__(self, tokens):
+        pooled = RingTransformerEncoder(
+            window=self.window, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, dtype=self.dtype,
+            seq_axis=self.seq_axis, seq_shards=self.seq_shards,
+        )(tokens)
         logits = nn.Dense(self.n_actions, dtype=jnp.float32)(pooled)
         value = nn.Dense(1, dtype=jnp.float32)(pooled)
         return logits, jnp.squeeze(value, axis=-1)
@@ -222,27 +246,22 @@ class RingTransformerPolicy(nn.Module):
         logits, value = self.apply(params, tokens)
         return logits, value, carry
 
-def with_seq_sharding(
-    policy: RingTransformerPolicy, axis: str, shards: int
-) -> "RingTransformerPolicy":
-    """Same hyperparams/param structure, sharded-attention mode.  A free
-    function (not a method): flax would treat a module constructed
-    inside a module method as a child submodule."""
+
+def with_seq_sharding(policy, axis: str, shards: int):
+    """Same hyperparams/param structure, sharded-attention mode — any
+    module with window/seq_axis/seq_shards fields (single-pair or
+    portfolio ring policy).  A free function (not a method): flax would
+    treat a module constructed inside a module method as a child
+    submodule."""
     if policy.window % shards != 0:
         raise ValueError(
             f"seq shard count {shards} must divide window {policy.window}"
         )
-    return RingTransformerPolicy(
-        n_actions=policy.n_actions, window=policy.window,
-        d_model=policy.d_model, n_heads=policy.n_heads,
-        n_layers=policy.n_layers, dtype=policy.dtype,
-        seq_axis=axis, seq_shards=shards,
-    )
+    return policy.clone(seq_axis=axis, seq_shards=shards)
 
 
-def seq_sharded_forward(policy: RingTransformerPolicy, params, tokens,
-                        mesh, axis: str = "seq"):
-    """Apply a RingTransformerPolicy with the WINDOW sharded over
+def seq_sharded_forward(policy, params, tokens, mesh, axis: str = "seq"):
+    """Apply a ring policy with the WINDOW sharded over
     ``mesh[axis]``: tokens (..., window, token_dim) enter with their
     token axis split across devices; attention runs as a ring; the
     pooled logits/value come back replicated.  Batch dims stay

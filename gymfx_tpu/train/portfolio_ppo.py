@@ -22,6 +22,15 @@ import optax
 
 from gymfx_tpu.core import portfolio as P
 from gymfx_tpu.train.common import masked_reset
+from gymfx_tpu.train.policies import RingTransformerEncoder, is_token_policy
+
+
+def _per_pair_heads(pooled, n_pairs: int):
+    """Shared actor-critic head: per-pair categorical logits (I, 3) +
+    scalar value — one definition for all portfolio policies."""
+    logits = nn.Dense(n_pairs * 3, dtype=jnp.float32)(pooled)
+    value = nn.Dense(1, dtype=jnp.float32)(pooled)
+    return logits.reshape(*logits.shape[:-1], n_pairs, 3), jnp.squeeze(value, -1)
 
 
 class PortfolioMLPPolicy(nn.Module):
@@ -34,11 +43,7 @@ class PortfolioMLPPolicy(nn.Module):
         x = x.astype(self.dtype)
         for width in self.hidden:
             x = nn.tanh(nn.Dense(width, dtype=self.dtype)(x))
-        logits = nn.Dense(self.n_pairs * 3, dtype=jnp.float32)(x)
-        value = nn.Dense(1, dtype=jnp.float32)(x)
-        return logits.reshape(*logits.shape[:-1], self.n_pairs, 3), jnp.squeeze(
-            value, -1
-        )
+        return _per_pair_heads(x, self.n_pairs)
 
 
 class PortfolioTransformerPolicy(nn.Module):
@@ -70,11 +75,34 @@ class PortfolioTransformerPolicy(nn.Module):
             y = nn.Dense(self.d_model, dtype=self.dtype)(y)
             x = x + y
         pooled = jnp.mean(nn.LayerNorm(dtype=self.dtype)(x), axis=-2)
-        logits = nn.Dense(self.n_pairs * 3, dtype=jnp.float32)(pooled)
-        value = nn.Dense(1, dtype=jnp.float32)(pooled)
-        return logits.reshape(*logits.shape[:-1], self.n_pairs, 3), jnp.squeeze(
-            value, -1
-        )
+        return _per_pair_heads(pooled, self.n_pairs)
+
+
+class PortfolioRingTransformerPolicy(nn.Module):
+    """Portfolio actor-critic over the shared RingTransformerEncoder:
+    attention over bars (tokens carry all pairs' features) that can run
+    sequence-parallel ring attention over a 'seq' mesh axis — BASELINE
+    config 5's portfolio + Transformer + pod-scale combination.  Use
+    train.policies.seq_sharded_forward for the sharded mode; parameters
+    are identical in both modes."""
+
+    n_pairs: int
+    window: int = 32
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+    seq_axis: Any = None
+    seq_shards: int = 1
+
+    @nn.compact
+    def __call__(self, tokens):
+        pooled = RingTransformerEncoder(
+            window=self.window, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, dtype=self.dtype,
+            seq_axis=self.seq_axis, seq_shards=self.seq_shards,
+        )(tokens)
+        return _per_pair_heads(pooled, self.n_pairs)
 
 
 class PortfolioPPOConfig(NamedTuple):
@@ -89,7 +117,7 @@ class PortfolioPPOConfig(NamedTuple):
     ent_coef: float = 0.01
     vf_coef: float = 0.5
     max_grad_norm: float = 0.5
-    policy: str = "mlp"  # mlp | transformer
+    policy: str = "mlp"  # mlp | transformer | transformer_ring
 
 
 class PortfolioTrainState(NamedTuple):
@@ -128,17 +156,21 @@ class PortfolioPPOTrainer:
         n_pairs = env.cfg.n_pairs
         if pcfg.policy == "transformer":
             self.policy = PortfolioTransformerPolicy(n_pairs=n_pairs)
+        elif pcfg.policy == "transformer_ring":
+            self.policy = PortfolioRingTransformerPolicy(
+                n_pairs=n_pairs, window=env.cfg.window_size
+            )
         elif pcfg.policy == "mlp":
             self.policy = PortfolioMLPPolicy(n_pairs=n_pairs)
         else:
             raise ValueError(
-                f"portfolio trainer supports policy mlp|transformer, "
-                f"got {pcfg.policy!r}"
+                f"portfolio trainer supports policy "
+                f"mlp|transformer|transformer_ring, got {pcfg.policy!r}"
             )
         self.optimizer = self._make_optimizer()
         self._reset_state, reset_obs = P.reset(env.cfg, env.params, env.data)
         self._window = env.cfg.window_size
-        self._is_transformer = pcfg.policy == "transformer"
+        self._is_transformer = is_token_policy(pcfg.policy)
         self._reset_vec = self._encode(reset_obs)
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
 

@@ -124,3 +124,54 @@ def test_ppo_trains_with_transformer_ring_policy():
     assert np.isfinite(float(metrics["loss"]))
     state, metrics = trainer.train_step(state)
     assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs a multi-device (CPU) mesh")
+def test_portfolio_ring_policy_seq_sharded_matches():
+    """BASELINE config 5 combined: the PORTFOLIO ring policy with its
+    window sharded over 'seq' matches its own single-device forward."""
+    from gymfx_tpu.train.portfolio_ppo import PortfolioRingTransformerPolicy
+
+    window = 8 * N_DEV
+    policy = PortfolioRingTransformerPolicy(
+        n_pairs=3, window=window, d_model=32, n_heads=2, n_layers=2
+    )
+    tokens = _tokens(4, window, 9, seed=5)
+    params = policy.init(jax.random.PRNGKey(0), tokens[0])
+    logits_ref, value_ref = jax.vmap(lambda t: policy.apply(params, t))(tokens)
+    mesh = make_mesh({"seq": N_DEV})
+    logits_ring, value_ring = seq_sharded_forward(policy, params, tokens, mesh)
+    assert logits_ring.shape == logits_ref.shape == (4, 3, 3)
+    np.testing.assert_allclose(
+        np.asarray(logits_ring), np.asarray(logits_ref), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(value_ring), np.asarray(value_ref), atol=2e-5
+    )
+
+
+def test_portfolio_ppo_trains_with_transformer_ring(tmp_path):
+    import pandas as pd
+
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+    from gymfx_tpu.train.portfolio_ppo import (
+        PortfolioPPOConfig,
+        PortfolioPPOTrainer,
+    )
+
+    closes = 1.1 * (1.0 + 2e-4) ** np.arange(60)
+    pd.DataFrame({
+        "DATE_TIME": pd.date_range("2024-01-01", periods=60, freq="1min"),
+        "OPEN": closes, "HIGH": closes, "LOW": closes, "CLOSE": closes,
+        "VOLUME": 0.0,
+    }).to_csv(tmp_path / "a.csv", index=False)
+    env = PortfolioEnvironment({
+        "portfolio_files": {"EUR_USD": str(tmp_path / "a.csv")},
+        "window_size": 8,
+    })
+    pcfg = PortfolioPPOConfig(n_envs=4, horizon=8, epochs=1, minibatches=2,
+                              policy="transformer_ring")
+    tr = PortfolioPPOTrainer(env, pcfg)
+    s = tr.init_state(0)
+    s, m = tr.train_step(s)
+    assert np.isfinite(float(m["loss"]))
