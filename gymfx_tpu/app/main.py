@@ -290,6 +290,39 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
         summary["event_context_diagnostics"] = {}
     if batch_stats is not None:
         summary["batch"] = batch_stats
+    if config.get("verify_execution"):
+        # independent-engine verification (the reference's Nautilus-env
+        # role): replay env 0's executed action stream through the
+        # float64 replay engine and reconcile the realized balances.
+        # The scan side is NOT re-run — this episode's final state is
+        # reused.  Unsupported configs record a skip, never abort a
+        # finished run.
+        from gymfx_tpu.simulation.crosscheck import crosscheck_episode
+
+        # done fires on dataset exhaustion as well as bankruptcy
+        # (core/env.py termination); only bankruptcy invalidates the
+        # cross-check — an exhausted episode is a complete action
+        # stream.  Distinguish by the bar cursor (exact in any compute
+        # dtype): exhaustion means the cursor reached the final bar.
+        final_t = int(np.asarray(jax.device_get(state.t)))
+        bankrupt = bool(done.any()) and final_t < env.n_bars - 1
+        try:
+            summary["execution_crosscheck"] = crosscheck_episode(
+                config,
+                np.asarray(out["action"])[:n_steps].tolist(),
+                seed=seed,
+                env=env,
+                scan_state=state,
+                terminated=bankrupt,
+            )
+        except (ValueError, TypeError) as exc:
+            # TypeError covers null-valued instrument keys in a config
+            # file (int(None) in the spec resolver) — a skipped
+            # verification must never abort a finished run
+            summary["execution_crosscheck"] = {
+                "status": "skipped",
+                "reason": f"{type(exc).__name__}: {exc}",
+            }
     return summary
 
 

@@ -30,6 +30,7 @@ def default_broker(config):
     plugin_params={
         "oanda_token": None,
         "oanda_account_id": None,
+        "oanda_instrument": "EUR_USD",
         "oanda_practice": True,
     },
 )

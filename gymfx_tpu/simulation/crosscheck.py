@@ -1,0 +1,290 @@
+"""Scan-vs-replay execution cross-check.
+
+The role the Nautilus-backed env plays in the reference — an
+independent engine verifying the training env's execution — done the
+TPU-framework way: run one episode's action stream through BOTH engines
+and reconcile their realized balances.
+
+  * the SCAN engine (core/broker.py) is the throughput path: pending
+    market orders fill at the next bar's open, displaced adversely by
+    the profile rate, commission per side (reference timing:
+    backtrader's cheat-on-open=False next-bar-open fills);
+  * the REPLAY engine (simulation/replay.py) is the verification twin.
+    Its latency model makes the timing line up exactly: a target
+    submitted with ``latency_ms == one bar interval`` fills at the
+    FIRST path tick of the next frame — the next bar's open — which is
+    the scan engine's fill rule.
+
+The instrument is resolved from the layered config through
+``contracts.instrument_spec_from_config`` (the reference's env-side
+resolver, simulation_engines/nautilus_gym.py:34-51), so
+``instrument`` / ``price_precision`` / ``size_precision`` /
+``min_quantity`` / ``margin_init`` config keys drive the verification
+venue.  Venue quantization (DIVERGENCES.md #9d) means a fractional
+``position_size`` under ``size_precision=0`` shows up here as a
+divergence — which is the point: the cross-check makes the engines'
+differences measurable instead of assumed.
+
+Scope (v1): ``strategy_plugin`` = default flow (market orders,
+long/short/flip/flat — no brackets), event overlay off, financing off.
+Bracketed strategies need SL/TP price reconstruction from indicator
+state and are verified instead by the fixture suites
+(tests/test_brackets.py, tests/test_execution_profile.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from gymfx_tpu.contracts import (
+    ExecutionCostProfile,
+    MarketFrame,
+    SCHEMA_VERSION,
+    TargetAction,
+    instrument_spec_from_config,
+)
+
+
+def _profile_for_replay(config: Dict[str, Any], bar_ms: float) -> ExecutionCostProfile:
+    """The episode's cost assumptions as a replay profile whose latency
+    is exactly one bar — the scan engine's next-open fill timing."""
+    from gymfx_tpu.core.types import _parse_profile
+
+    profile = _parse_profile(config)
+    if profile is None:
+        # key resolution mirrors the scan engine's (core/types.py
+        # make_env_params): slippage_perc (default_broker's param) wins
+        # over the bare slippage key
+        slippage = float(
+            config.get("slippage_perc", config.get("slippage", 0.0)) or 0.0
+        )
+        profile = ExecutionCostProfile(
+            schema_version=SCHEMA_VERSION,
+            profile_id="crosscheck-from-config",
+            commission_rate_per_side=float(config.get("commission", 0.0) or 0.0),
+            full_spread_rate=0.0,
+            slippage_bps_per_side=slippage * 1e4,
+            latency_ms=0,
+            financing_enabled=False,
+            intrabar_collision_policy="worst_case",
+            limit_fill_policy="conservative",
+            margin_model="leveraged",
+            enforce_margin_preflight=False,
+            random_seed=0,
+        )
+    return dataclasses.replace(profile, latency_ms=int(round(bar_ms)))
+
+
+def _targets_from_actions(
+    actions: Sequence[int], position_size: float, allow_flat: bool
+) -> List[Optional[float]]:
+    """Default-flow intent tracking (core/strategy.py:_default_flow):
+    1 -> +size when pos <= 0, 2 -> -size when pos >= 0, 3 -> flat
+    (coerced to hold unless allow_flat_action, core/env.py action
+    coercion), 0/ineffective -> no order.  Returns a target per step or
+    None."""
+    cur = 0.0
+    targets: List[Optional[float]] = []
+    for a in actions:
+        a = int(a)
+        if a == 3 and not allow_flat:
+            a = 0  # the env coerces out-of-range actions to hold
+        target: Optional[float] = None
+        if a == 1 and cur <= 0:
+            target = position_size
+        elif a == 2 and cur >= 0:
+            target = -position_size
+        elif a == 3 and cur != 0:
+            target = 0.0
+        targets.append(target)
+        if target is not None:
+            cur = target
+    return targets
+
+
+def crosscheck_episode(
+    config: Dict[str, Any],
+    actions: Optional[Sequence[int]] = None,
+    *,
+    steps: Optional[int] = None,
+    seed: int = 0,
+    env: Optional[Any] = None,
+    scan_state: Optional[Any] = None,
+    terminated: bool = False,
+) -> Dict[str, Any]:
+    """Run one episode through both engines; return both balances.
+
+    ``actions``: explicit action stream; default = the config's driver
+    (driver_mode) generates it on the scan side and the executed stream
+    is replayed.  Callers that already ran the scan episode (the CLI's
+    ``--verify_execution`` path) pass their ``env`` + final
+    ``scan_state`` (+ ``terminated``) to skip the duplicate rollout.
+    Returns scan/replay realized balances, divergence, the replay
+    result hashes, and the per-engine fill counts.
+    """
+    from gymfx_tpu.core import broker
+    from gymfx_tpu.core.rollout import replay_driver
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.simulation.replay import ReplayAdapter
+
+    config = dict(config)
+    if str(config.get("strategy_plugin", "default_strategy")) not in (
+        "default_strategy",
+        "default",
+    ):
+        raise ValueError(
+            "crosscheck v1 verifies the default market-order flow; bracketed "
+            "strategies are verified by the fixture suites"
+        )
+    if config.get("event_context_execution_overlay"):
+        raise ValueError("crosscheck requires the event overlay disabled")
+    if str(config.get("action_space_mode", "discrete")).lower() == "continuous":
+        raise ValueError(
+            "crosscheck v1 requires discrete actions: the recorded action "
+            "stream stores raw continuous values truncated to int, which "
+            "cannot reconstruct the env's thresholded intents"
+        )
+
+    if env is None:
+        env = Environment(config)
+    if env.cfg.financing_enabled:
+        raise ValueError(
+            "crosscheck v1 does not model financing; disable financing_enabled "
+            "(both engines' financing is cross-checked by "
+            "tests/test_execution_profile.py)"
+        )
+    bar_ms = env.dataset.bar_interval_ms()
+    if not bar_ms:
+        raise ValueError("crosscheck requires timestamped bars")
+
+    n_bars = env.n_bars
+
+    def normalize(raw: Sequence[int], cap: int) -> List[int]:
+        return [int(a) for a in raw][: min(len(raw), cap)]
+
+    def raise_if_terminated(done_any: bool) -> None:
+        if done_any:
+            raise ValueError(
+                "episode terminated early (bankruptcy); crosscheck needs the "
+                "full action stream to execute in both engines"
+            )
+
+    if scan_state is not None:
+        # the caller already ran the scan episode — reuse its outcome.
+        # No n_bars-2 cap: the caller's episode may have run right up to
+        # exhaustion (t == n_bars-1); actions past bar n_bars-1 were
+        # never seen by the strategy (exhausted steps don't act).
+        if actions is None:
+            raise ValueError("scan_state requires the executed action stream")
+        raise_if_terminated(terminated)
+        actions = normalize(actions, n_bars)
+        state = jax.device_get(scan_state)
+    else:
+        if actions is None:
+            driver = env.make_driver()
+            n_steps = min(int(steps or config.get("steps", 500)), n_bars - 2)
+            state, out = env.rollout(driver, n_steps, seed=seed)
+            actions = np.asarray(out["action"])[:n_steps].tolist()
+        else:
+            actions = normalize(actions, n_bars - 2)
+            state, out = env.rollout(
+                replay_driver(np.asarray(actions)), len(actions), seed=seed
+            )
+        state = jax.device_get(state)
+        raise_if_terminated(bool(np.asarray(jax.device_get(out["done"]), bool).any()))
+    n_steps = len(actions)
+    scan_balance = float(
+        np.asarray(broker.realized_balance(state, env.params))
+    )
+
+    # replay side: frames are the dataset bars; scan step i processes
+    # bar i (step 0 is the warmup on bar 0), so the action taken at step
+    # i is submitted on frame i and the one-bar latency fills it at bar
+    # i+1's first path tick — the bar's open, the scan engine's rule
+    spec = instrument_spec_from_config(config)
+    ts = env.dataset.timestamps.to_numpy().astype("datetime64[ns]").astype(np.int64)
+    # the same (compute-dtype) price arrays the scan engine executed on,
+    # so the comparison isolates engine semantics, not float width
+    o = np.asarray(jax.device_get(env.data.open), np.float64)
+    h = np.asarray(jax.device_get(env.data.high), np.float64)
+    l = np.asarray(jax.device_get(env.data.low), np.float64)
+    c = np.asarray(jax.device_get(env.data.close), np.float64)
+    frames = [
+        MarketFrame(
+            instrument_id=spec.instrument_id,
+            timeframe_minutes=max(1, int(round(bar_ms / 60_000.0))),
+            ts_event_ns=int(ts[j]),
+            open=float(o[j]),
+            high=float(h[j]),
+            low=float(l[j]),
+            close=float(c[j]),
+            volume=0.0,
+            execution_path=(float(o[j]), float(h[j]), float(l[j]), float(c[j])),
+        )
+        # frames stop at bar n_steps-1, the last bar the scan episode
+        # processed: its final pending order never fills (the episode
+        # ends first), so the replay twin leaves it in flight too
+        # (orders_pending_unexecuted)
+        for j in range(min(n_steps, n_bars))
+    ]
+    position_size = float(config.get("position_size", 1.0) or 1.0)
+    targets = _targets_from_actions(
+        actions, position_size, bool(env.cfg.allow_flat_action)
+    )
+    target_actions = [
+        TargetAction(
+            instrument_id=spec.instrument_id,
+            ts_event_ns=int(ts[i]),
+            target_units=t,
+            action_id=f"step-{i}",
+        )
+        for i, t in enumerate(targets)
+        if t is not None
+    ]
+
+    profile = _profile_for_replay(config, bar_ms)
+    initial_cash = float(config.get("initial_cash", 10000.0) or 10000.0)
+    result = ReplayAdapter(profile).run(
+        instrument_specs=[spec],
+        frames=frames,
+        actions=target_actions,
+        initial_cash=initial_cash,
+        base_currency=spec.quote_currency,
+        default_leverage=float(config.get("leverage", 1.0) or 1.0),
+    )
+    replay_balance = float(result["summary"]["final_balance"])
+    fills = [e for e in result["events"] if e["event_type"] == "order_filled"]
+
+    # the replay venue quotes at price_precision (like the reference's
+    # Nautilus book) while the scan engine fills at unquantized floats:
+    # each fill can differ by up to half a tick per unit, so the
+    # expected agreement bound is fills * units * tick/2 (+ f32 noise)
+    tick = 10.0 ** (-spec.price_precision)
+    # dtype rounding term scaled to the scan engine's actual compute
+    # dtype (f32 ~1e-7 relative, bf16 ~4e-3 — both supported dtypes)
+    import jax.numpy as jnp
+
+    dtype_eps = 3.0 * float(jnp.finfo(env.cfg.dtype).eps) * float(np.max(c))
+    filled_units = sum(float(f["quantity"]) for f in fills)
+    quantization_bound = filled_units * (tick / 2.0 + dtype_eps) + 0.01
+
+    return {
+        "schema": "scan_replay_crosscheck.v1",
+        "instrument": spec.instrument_id,
+        "steps": n_steps,
+        "actions_submitted": len(target_actions),
+        "scan_realized_balance": scan_balance,
+        "replay_final_balance": replay_balance,
+        "divergence": abs(scan_balance - replay_balance),
+        "quantization_bound": quantization_bound,
+        "within_bound": abs(scan_balance - replay_balance) <= quantization_bound,
+        "scan_trades": int(np.asarray(state.trade_count)),
+        "replay_fills": len(fills),
+        "replay_pending_unexecuted": result["native"]["orders_pending_unexecuted"],
+        "replay_result_hash": result["result_hash"],
+        "profile_id": profile.profile_id,
+        "latency_ms": profile.latency_ms,
+    }

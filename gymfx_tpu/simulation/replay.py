@@ -38,6 +38,11 @@ Execution model:
     (must trade strictly through; fills at the limit), touch (an exact
     touch fills at the limit), cross (a touch fills at the touching
     tick's market price — price improvement);
+  * venue order validation: book prices and SL/TP triggers are
+    quantized to the instrument's price_precision, order quantities to
+    its size_precision, and orders below min_quantity are denied
+    (order_denied event) — the reference venue's make_price/make_qty/
+    RiskEngine behavior (nautilus_adapter.py:57-72,111-113,190);
   * margin preflight: opening units require margin_init * notional
     (standard model) or margin_init * notional / leverage (leveraged
     model), converted to the account currency at the current mid;
@@ -130,6 +135,20 @@ def stable_hash(value: Any) -> str:
 def _fmt(x: float, precision: int = 10) -> str:
     """Canonical decimal formatting so hashes are platform-stable."""
     return f"{x:.{precision}f}".rstrip("0").rstrip(".") or "0"
+
+
+def make_price(spec: InstrumentSpec, value: float) -> float:
+    """Quantize a price to the instrument's price precision — the venue
+    book holds Price objects at ``price_precision``, exactly as the
+    reference builds QuoteTicks through ``instrument.make_price``
+    (reference simulation_engines/nautilus_adapter.py:111-112)."""
+    return round(float(value), spec.price_precision)
+
+
+def make_qty(spec: InstrumentSpec, value: float) -> float:
+    """Quantize an order quantity to the instrument's size precision
+    (reference ``instrument.make_qty``, nautilus_adapter.py:190)."""
+    return round(float(value), spec.size_precision)
 
 
 class _Position:
@@ -308,7 +327,9 @@ class ReplayAdapter:
         def market_price(spec: InstrumentSpec, mid: float, side: str) -> float:
             """Top-of-book fill price for a market order, with the fill
             model's one-tick probabilistic slippage."""
-            price = mid * (1.0 + adverse) if side == "BUY" else mid * (1.0 - adverse)
+            price = make_price(
+                spec, mid * (1.0 + adverse) if side == "BUY" else mid * (1.0 - adverse)
+            )
             if fill_model.slips():
                 tick = 10.0 ** (-spec.price_precision)
                 price = price + tick if side == "BUY" else price - tick
@@ -438,10 +459,11 @@ class ReplayAdapter:
             # latency-delayed orders due by now fill at this frame's
             # first path tick, before bracket evaluation
             flush_pending(frame, path[0])
-            # walk intrabar ticks: brackets can exit mid-path
+            # walk intrabar ticks: brackets can exit mid-path (book
+            # prices live at the instrument's price precision)
             for mid in path:
-                bid = mid * (1.0 - adverse)
-                ask = mid * (1.0 + adverse)
+                bid = make_price(spec, mid * (1.0 - adverse))
+                ask = make_price(spec, mid * (1.0 + adverse))
                 last_mid[frame.instrument_id] = mid
                 check_brackets(frame.instrument_id, bid, ask, mid, frame.ts_event_ns)
             apply_rollover(frame.ts_event_ns)
@@ -471,13 +493,32 @@ class ReplayAdapter:
 
             mid = last_mid[frame.instrument_id]
             side = "BUY" if delta > 0 else "SELL"
+            # venue-side order validation: quantity quantized to the
+            # instrument's size increment, orders below min_quantity
+            # denied (the reference's RiskEngine/venue behavior around
+            # instrument.make_qty / min_quantity,
+            # nautilus_adapter.py:57-72,190)
+            qty = make_qty(spec, abs(delta))
+            if qty <= 0.0 or qty < float(spec.min_quantity):
+                emit(
+                    {
+                        "event_type": "order_denied",
+                        "ts_event_ns": int(frame.ts_event_ns),
+                        "instrument_id": frame.instrument_id,
+                        "action_id": action.action_id,
+                        "reason": "ORDER_BELOW_MIN_QUANTITY",
+                        "quantity": _fmt(qty),
+                        "min_quantity": _fmt(float(spec.min_quantity)),
+                    }
+                )
+                continue
 
             if profile.enforce_margin_preflight:
                 opening = 0.0
                 if current == 0 or current * delta > 0:
-                    opening = abs(delta)
-                elif abs(delta) > abs(current):
-                    opening = abs(delta) - abs(current)
+                    opening = qty
+                elif qty > abs(current):
+                    opening = qty - abs(current)
                 if opening > 0:
                     notional_quote = opening * mid
                     required_quote = notional_quote * float(spec.margin_init)
@@ -510,18 +551,18 @@ class ReplayAdapter:
                 # the submit->venue trip delays EXECUTION of new orders;
                 # resting brackets at the venue are unaffected
                 execute_at = frame.ts_event_ns + latency_ns
-                inflight_units[frame.instrument_id] += delta
+                inflight_units[frame.instrument_id] += qty if delta > 0 else -qty
                 pending_orders.append(
                     {
                         "instrument_id": frame.instrument_id,
                         "execute_at_ns": execute_at,
                         "side": side,
-                        "qty": abs(delta),
+                        "qty": qty,
                         "order_id": order_id,
                         "action_id": action.action_id,
                         "arm_brackets": wants_brackets,
-                        "sl": float(action.stop_loss_price or 0.0),
-                        "tp": float(action.take_profit_price or 0.0),
+                        "sl": make_price(spec, float(action.stop_loss_price or 0.0)),
+                        "tp": make_price(spec, float(action.take_profit_price or 0.0)),
                     }
                 )
                 emit(
@@ -532,7 +573,7 @@ class ReplayAdapter:
                         "action_id": action.action_id,
                         "client_order_id": order_id,
                         "side": side,
-                        "quantity": _fmt(abs(delta)),
+                        "quantity": _fmt(qty),
                         "execute_at_ns": int(execute_at),
                     }
                 )
@@ -540,7 +581,7 @@ class ReplayAdapter:
             fill(
                 frame.instrument_id,
                 side,
-                abs(delta),
+                qty,
                 market_price(spec, mid, side),
                 mid,
                 frame.ts_event_ns,
@@ -549,8 +590,8 @@ class ReplayAdapter:
             )
             if wants_brackets:
                 brackets[frame.instrument_id] = {
-                    "sl": float(action.stop_loss_price),
-                    "tp": float(action.take_profit_price),
+                    "sl": make_price(spec, float(action.stop_loss_price)),
+                    "tp": make_price(spec, float(action.take_profit_price)),
                 }
 
         open_positions = sum(1 for p in positions.values() if p.units != 0)

@@ -1,0 +1,171 @@
+"""Scan-vs-replay execution cross-check (simulation/crosscheck.py).
+
+The scan (training) engine's fills, commissions and realized pnl are
+verified against the independent float64 replay engine on the SAME
+action stream — the role the Nautilus engine plays for the reference
+(reference simulation_engines/nautilus_gym.py).  Timing is aligned by
+the replay latency model: one bar of latency == fill at next bar's
+open, the scan rule.
+"""
+import numpy as np
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.simulation.crosscheck import crosscheck_episode
+
+DATA = "examples/data/eurusd_sample.csv"
+
+PROFILE = {
+    "schema_version": "execution_cost_profile.v1",
+    "profile_id": "crosscheck-test",
+    "commission_rate_per_side": 0.00002,
+    "full_spread_rate": 0.0001,
+    "slippage_bps_per_side": 0.2,
+    "latency_ms": 0,
+    "financing_enabled": False,
+    "intrabar_collision_policy": "worst_case",
+    "limit_fill_policy": "conservative",
+    "margin_model": "leveraged",
+    "enforce_margin_preflight": False,
+    "random_seed": 0,
+}
+
+
+def _config(**overrides):
+    config = dict(DEFAULT_VALUES, input_data_file=DATA, position_size=1000.0)
+    config.update(overrides)
+    return config
+
+
+def test_frictionless_random_episode_reconciles_to_the_cent():
+    result = crosscheck_episode(
+        _config(driver_mode="random", steps=300), seed=3
+    )
+    assert result["replay_fills"] > 50  # the episode actually traded
+    assert result["divergence"] <= 0.01
+    assert result["within_bound"]
+
+
+def test_costed_episode_within_quantization_bound():
+    """With commission+spread the replay venue quotes at
+    price_precision; agreement is bounded by fills x units x half-tick."""
+    result = crosscheck_episode(
+        _config(
+            driver_mode="random", steps=300, execution_cost_profile=PROFILE
+        ),
+        seed=3,
+    )
+    assert result["replay_fills"] > 50
+    assert result["within_bound"], result
+    # and the bound is meaningful, not vacuous (vs the $10k account)
+    assert result["quantization_bound"] < 2.0
+
+
+def test_explicit_action_stream_with_coerced_flat_action():
+    """Action 3 is coerced to hold by the env (allow_flat_action off);
+    the cross-check must model the same coercion."""
+    actions = [1, 0, 2, 0, 1, 3, 0, 1, 0, 0, 2, 0]
+    result = crosscheck_episode(_config(), actions)
+    assert result["actions_submitted"] == 4  # 3 was a no-op, not a flatten
+    assert result["divergence"] <= 0.01
+
+
+def test_final_pending_order_left_in_flight_in_both_engines():
+    """An order submitted on the last step never fills in the scan
+    episode; the replay twin must leave it pending, not fill it."""
+    # action on the last step opens; episode ends before the fill bar
+    actions = [0] * 10 + [1]
+    result = crosscheck_episode(_config(), actions)
+    assert result["replay_fills"] == 0
+    assert result["replay_pending_unexecuted"] == 1
+    assert result["divergence"] <= 1e-9
+
+
+def test_bracket_strategies_and_financing_rejected():
+    with pytest.raises(ValueError, match="default market-order flow"):
+        crosscheck_episode(_config(strategy_plugin="direct_atr_sltp"), [0])
+    import dataclasses
+
+    profile = dict(PROFILE, financing_enabled=True)
+    config = _config(
+        execution_cost_profile=profile,
+        financing_rate_data_file="examples/data/fx_rollover_rates_smoke.csv",
+    )
+    with pytest.raises(ValueError, match="financing"):
+        crosscheck_episode(config, [0])
+
+
+def test_cli_verify_execution_flag():
+    from gymfx_tpu.app.main import _run_env
+
+    summary = _run_env(
+        _config(
+            driver_mode="random",
+            steps=120,
+            verify_execution=True,
+            results_file=None,
+            save_config=None,
+        )
+    )
+    cc = summary["execution_crosscheck"]
+    assert cc["schema"] == "scan_replay_crosscheck.v1"
+    assert cc["within_bound"]
+    assert cc["steps"] == 120
+
+
+def test_cli_verify_execution_full_default_episode():
+    """A 500-step episode covers all 500 steppable bars of the 501-bar
+    sample; the reuse path must cover the final fill bar."""
+    from gymfx_tpu.app.main import _run_env
+
+    summary = _run_env(
+        _config(
+            driver_mode="random",
+            steps=500,
+            verify_execution=True,
+            results_file=None,
+            save_config=None,
+        )
+    )
+    cc = summary["execution_crosscheck"]
+    assert cc["within_bound"], cc
+    assert cc["divergence"] <= 0.01  # frictionless default config
+
+
+def test_cli_verify_execution_exhausted_episode_still_verifies():
+    """Dataset exhaustion sets done but is NOT bankruptcy: asking for
+    more steps than the data holds must still run the cross-check."""
+    from gymfx_tpu.app.main import _run_env
+
+    summary = _run_env(
+        _config(
+            driver_mode="random",
+            steps=600,  # > 501 bars -> exhaustion terminates the episode
+            verify_execution=True,
+            results_file=None,
+            save_config=None,
+        )
+    )
+    cc = summary["execution_crosscheck"]
+    assert cc.get("status") != "skipped", cc
+    assert cc["within_bound"], cc
+
+
+def test_cli_verify_execution_unsupported_config_records_skip():
+    """An unsupported crosscheck config must not abort a finished run."""
+    from gymfx_tpu.app.main import _run_env
+
+    summary = _run_env(
+        _config(
+            driver_mode="random",
+            steps=60,
+            strategy_plugin="direct_atr_sltp",
+            verify_execution=True,
+            results_file=None,
+            save_config=None,
+        )
+    )
+    cc = summary["execution_crosscheck"]
+    assert cc["status"] == "skipped"
+    assert "default market-order flow" in cc["reason"]
+    assert "total_return" in summary  # the run itself still completed

@@ -499,3 +499,96 @@ def test_flip_fill_rearms_brackets():
     assert float(out.pos) == -1.0
     assert float(out.bracket_sl) == pytest.approx(1.13)
     assert float(out.bracket_tp) == pytest.approx(1.07)
+
+
+# ---------------------------------------------------------------------------
+# replay engine: venue order validation (precision quantization, min qty)
+# ---------------------------------------------------------------------------
+def test_replay_quantizes_order_quantity_to_size_precision():
+    from gymfx_tpu.contracts import TargetAction
+    from gymfx_tpu.simulation.fixtures import _bar, _eurusd, _ts
+
+    frames = [
+        _bar("EUR/USD.SIM", 1, _ts(i), 1.084 + i * 0.0001, 0.0) for i in range(1, 4)
+    ]
+    # size_precision=0: a fractional target quantizes to whole units
+    actions = [TargetAction("EUR/USD.SIM", _ts(1), 1500.4, "open-frac")]
+    result = ReplayAdapter(_frictionless()).run(
+        instrument_specs=[_eurusd()], frames=frames, actions=actions
+    )
+    fills = _fills(result)
+    assert len(fills) == 1
+    assert float(fills[0]["quantity"]) == pytest.approx(1500.0)
+    assert float(fills[0]["position_units_after"]) == pytest.approx(1500.0)
+
+
+def test_replay_denies_orders_below_min_quantity():
+    from gymfx_tpu.contracts import TargetAction
+    from gymfx_tpu.simulation.fixtures import _bar, _eurusd, _ts
+
+    frames = [
+        _bar("EUR/USD.SIM", 1, _ts(i), 1.084 + i * 0.0001, 0.0) for i in range(1, 4)
+    ]
+    # min_quantity=1000 on the fixture spec: a 500-unit order is denied
+    actions = [TargetAction("EUR/USD.SIM", _ts(1), 500.0, "too-small")]
+    result = ReplayAdapter(_frictionless()).run(
+        instrument_specs=[_eurusd()], frames=frames, actions=actions
+    )
+    assert _fills(result) == []
+    denied = [e for e in result["events"] if e["event_type"] == "order_denied"]
+    assert len(denied) == 1
+    assert denied[0]["reason"] == "ORDER_BELOW_MIN_QUANTITY"
+    assert float(result["summary"]["final_balance"]) == 100_000.0
+
+
+def test_replay_book_prices_quantized_to_price_precision():
+    from gymfx_tpu.contracts import TargetAction
+    from gymfx_tpu.simulation.fixtures import _bar, _eurusd, _ts
+
+    # a spread whose half-displacement is NOT a 5-decimal number:
+    # the book must quote at price_precision like the reference venue
+    frames = [
+        _bar("EUR/USD.SIM", 1, _ts(i), 1.08407, 0.000037) for i in range(1, 3)
+    ]
+    actions = [TargetAction("EUR/USD.SIM", _ts(1), 1000.0, "open")]
+    result = ReplayAdapter(
+        default_profile(
+            commission_rate_per_side=0.0,
+            full_spread_rate=0.000037,
+            slippage_bps_per_side=0.0,
+            enforce_margin_preflight=False,
+        )
+    ).run(instrument_specs=[_eurusd()], frames=frames, actions=actions)
+    fills = _fills(result)
+    assert len(fills) == 1
+    price = float(fills[0]["price"])
+    assert price == pytest.approx(round(price, 5), abs=1e-12)
+
+
+def test_instrument_spec_from_config_defaults_and_jpy_precision():
+    from gymfx_tpu.contracts import instrument_spec_from_config
+
+    spec = instrument_spec_from_config({})
+    assert spec.symbol == "EUR/USD"
+    assert spec.venue == "SIM"
+    assert spec.price_precision == 5
+    assert spec.margin_init == pytest.approx(0.05)
+    spec_jpy = instrument_spec_from_config({"instrument": "USD_JPY"})
+    assert spec_jpy.price_precision == 3  # JPY-quoted default, ref parity
+    spec_cfg = instrument_spec_from_config(
+        {
+            "instrument": "GBP/USD",
+            "simulation_venue": "X",
+            "price_precision": 4,
+            "size_precision": 2,
+            "margin_maint": 0.01,
+            "min_quantity": 10,
+            "lot_size": None,
+        }
+    )
+    assert spec_cfg.venue == "X"
+    assert spec_cfg.size_precision == 2
+    assert spec_cfg.lot_size is None
+    assert spec_cfg.instrument_id == "GBP/USD.X"
+    with pytest.raises(ValueError):
+        instrument_spec_from_config({"instrument": "EURUSD"})
