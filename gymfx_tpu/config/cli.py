@@ -44,7 +44,9 @@ def parse_args(argv=None):
     # TPU-framework flags
     parser.add_argument("--num_envs", type=int)
     parser.add_argument(
-        "--policy", choices=["mlp", "lstm", "transformer", "transformer_ring"]
+        "--policy",
+        choices=["mlp", "lstm", "transformer", "transformer_ring",
+                 "transformer_ulysses"],
     )
     parser.add_argument("--checkpoint_dir", type=str)
     parser.add_argument("--train_total_steps", type=int)

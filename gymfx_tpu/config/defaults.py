@@ -65,6 +65,7 @@ DEFAULT_VALUES = {
     "train_total_steps": 1_000_000,
     "checkpoint_dir": None,
     # policy: unset by default — PPO defaults to "mlp", IMPALA to "lstm";
-    # pass --policy mlp|lstm|transformer|transformer_ring to override.
+    # pass --policy mlp|lstm|transformer|transformer_ring|
+    # transformer_ulysses to override.
     "policy": None,
 }

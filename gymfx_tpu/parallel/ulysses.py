@@ -1,0 +1,95 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second sequence-parallel backend next to ring attention
+(parallel/ring_attention.py).  Where the ring streams K/V blocks around
+the mesh with ``ppermute`` (P communication steps, memory O(S/P)),
+Ulysses (DeepSpeed-Ulysses, Jacobs et al. 2023) uses two ``all_to_all``
+collectives: the incoming sequence-sharded Q/K/V are redistributed so
+each device holds the FULL sequence for H/P of the heads, attention
+runs locally and exactly (no online-softmax recurrence), and a second
+all-to-all restores sequence sharding.
+
+Trade-offs on TPU: the all-to-alls ride ICI as one fused collective
+each (latency ~2 hops instead of P ppermute steps), but each device must
+hold full-sequence activations for its head slice — memory O(S·H/P·D)
+vs the ring's O(S/P·H·D).  Short-window policies prefer Ulysses;
+million-token streams prefer the ring.  Requires n_heads % n_shards == 0.
+
+Same two entry points as the ring module:
+  ulysses_attention        (S, H, D) global view, wraps its own shard_map
+  ulysses_attention_inner  per-shard blocks inside an active shard_map
+                           (what the transformer_ulysses policy calls)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gymfx_tpu.parallel.ring_attention import full_attention
+
+
+def ulysses_attention_inner(
+    q_blk, k_blk, v_blk, *, axis: str, n_shards: int, causal: bool = False
+):
+    """Exact attention on per-shard blocks inside an active shard_map.
+
+    q/k/v blocks: (..., S/P, H, D) — the local sequence slice, any
+    leading batch dims.  ``axis`` must be a mesh axis in scope with
+    (static) size ``n_shards``; requires H % n_shards == 0.  Two
+    all-to-alls: scatter heads / gather sequence, run full local
+    attention over the device's H/P heads, then the inverse.
+    """
+    *_, sb, h, d = q_blk.shape
+    if h % n_shards != 0:
+        raise ValueError(
+            f"n_heads={h} must divide by the sequence-parallel degree "
+            f"{n_shards} for all-to-all sequence parallelism"
+        )
+    seq_ax = q_blk.ndim - 3
+    head_ax = q_blk.ndim - 2
+
+    def scatter_heads(x):
+        # (..., S/P, H, D) -> (..., S, H/P, D)
+        return jax.lax.all_to_all(
+            x, axis, split_axis=head_ax, concat_axis=seq_ax, tiled=True
+        )
+
+    def gather_heads(x):
+        # (..., S, H/P, D) -> (..., S/P, H, D)
+        return jax.lax.all_to_all(
+            x, axis, split_axis=seq_ax, concat_axis=head_ax, tiled=True
+        )
+
+    qg = scatter_heads(q_blk)
+    kg = scatter_heads(k_blk)
+    vg = scatter_heads(v_blk)
+    # full sequence, local head slice: plain exact attention — the
+    # causal mask is the ordinary global one, no ring-position algebra
+    out = full_attention(qg, kg, vg, causal=causal)
+    return gather_heads(out)
+
+
+def ulysses_attention(
+    q, k, v, *, mesh: Mesh, axis: str = "seq", causal: bool = False
+):
+    """Exact attention with the sequence sharded over ``mesh[axis]``.
+
+    q/k/v: (S, H, D) arrays (global view); returns (S, H, D) with the
+    same sharding.  S must divide by the axis size, H likewise.
+    """
+    s, h, d = q.shape
+    p = mesh.shape[axis]
+    if s % p != 0:
+        raise ValueError(f"sequence length {s} must divide mesh axis {axis}={p}")
+
+    def shard_fn(q_blk, k_blk, v_blk):
+        return ulysses_attention_inner(
+            q_blk, k_blk, v_blk, axis=axis, n_shards=p, causal=causal
+        )
+
+    spec = P(axis, None, None)
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    return fn(q, k, v)

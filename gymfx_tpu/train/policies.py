@@ -162,6 +162,11 @@ class RingTransformerEncoder(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     seq_shards: int = 1
+    # sequence-parallel backend for the sharded mode: "ring" streams
+    # K/V blocks with ppermute (memory O(S/P)); "ulysses" swaps
+    # heads<->sequence with two all_to_alls (full attention locally,
+    # needs n_heads % shards == 0) — parallel/ulysses.py
+    sp_backend: str = "ring"
 
     @nn.compact
     def __call__(self, tokens):
@@ -169,6 +174,7 @@ class RingTransformerEncoder(nn.Module):
             full_attention,
             ring_attention_inner,
         )
+        from gymfx_tpu.parallel.ulysses import ulysses_attention_inner
 
         head_dim = self.d_model // self.n_heads
         x = nn.Dense(self.d_model, dtype=self.dtype)(tokens.astype(self.dtype))
@@ -190,7 +196,12 @@ class RingTransformerEncoder(nn.Module):
             k = nn.DenseGeneral((self.n_heads, head_dim), dtype=self.dtype)(y)
             v = nn.DenseGeneral((self.n_heads, head_dim), dtype=self.dtype)(y)
             if self.seq_axis is not None:
-                a = ring_attention_inner(
+                sp_attention = (
+                    ulysses_attention_inner
+                    if self.sp_backend == "ulysses"
+                    else ring_attention_inner
+                )
+                a = sp_attention(
                     q, k, v, axis=self.seq_axis, n_shards=self.seq_shards
                 )
             else:
@@ -227,6 +238,7 @@ class RingTransformerPolicy(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     seq_shards: int = 1
+    sp_backend: str = "ring"
 
     @nn.compact
     def __call__(self, tokens):
@@ -234,6 +246,7 @@ class RingTransformerPolicy(nn.Module):
             window=self.window, d_model=self.d_model, n_heads=self.n_heads,
             n_layers=self.n_layers, dtype=self.dtype,
             seq_axis=self.seq_axis, seq_shards=self.seq_shards,
+            sp_backend=self.sp_backend,
         )(tokens)
         logits = nn.Dense(self.n_actions, dtype=jnp.float32)(pooled)
         value = nn.Dense(1, dtype=jnp.float32)(pooled)
@@ -326,7 +339,7 @@ class ContinuousMLPPolicy(nn.Module):
 
 # policies whose inputs are (window, token_dim) token sequences rather
 # than flat vectors — shared by every trainer's encode/init paths
-TOKEN_POLICIES = ("transformer", "transformer_ring")
+TOKEN_POLICIES = ("transformer", "transformer_ring", "transformer_ulysses")
 
 
 def is_token_policy(name: str) -> bool:
@@ -337,7 +350,7 @@ def policy_kwargs_for(name: str, kwargs: Dict[str, Any], window: int) -> Dict[st
     """Trainer-side kwarg resolution: the ring policy needs the GLOBAL
     window for its positional embeddings (sliced per shard)."""
     kwargs = dict(kwargs)
-    if name == "transformer_ring":
+    if name in ("transformer_ring", "transformer_ulysses"):
         kwargs.setdefault("window", window)
     return kwargs
 
@@ -353,4 +366,8 @@ def make_policy(name: str, n_actions: int = 3, dtype: Any = jnp.float32, **kw):
         return TransformerPolicy(n_actions=n_actions, dtype=dtype, **kw)
     if name == "transformer_ring":
         return RingTransformerPolicy(n_actions=n_actions, dtype=dtype, **kw)
+    if name == "transformer_ulysses":
+        return RingTransformerPolicy(
+            n_actions=n_actions, dtype=dtype, sp_backend="ulysses", **kw
+        )
     raise ValueError(f"unknown policy {name!r}")

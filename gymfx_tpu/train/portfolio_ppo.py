@@ -94,6 +94,7 @@ class PortfolioRingTransformerPolicy(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Any = None
     seq_shards: int = 1
+    sp_backend: str = "ring"
 
     @nn.compact
     def __call__(self, tokens):
@@ -101,6 +102,7 @@ class PortfolioRingTransformerPolicy(nn.Module):
             window=self.window, d_model=self.d_model, n_heads=self.n_heads,
             n_layers=self.n_layers, dtype=self.dtype,
             seq_axis=self.seq_axis, seq_shards=self.seq_shards,
+            sp_backend=self.sp_backend,
         )(tokens)
         return _per_pair_heads(pooled, self.n_pairs)
 
@@ -117,7 +119,7 @@ class PortfolioPPOConfig(NamedTuple):
     ent_coef: float = 0.01
     vf_coef: float = 0.5
     max_grad_norm: float = 0.5
-    policy: str = "mlp"  # mlp | transformer | transformer_ring
+    policy: str = "mlp"  # mlp | transformer | transformer_ring | transformer_ulysses
 
 
 class PortfolioTrainState(NamedTuple):
@@ -156,16 +158,19 @@ class PortfolioPPOTrainer:
         n_pairs = env.cfg.n_pairs
         if pcfg.policy == "transformer":
             self.policy = PortfolioTransformerPolicy(n_pairs=n_pairs)
-        elif pcfg.policy == "transformer_ring":
+        elif pcfg.policy in ("transformer_ring", "transformer_ulysses"):
             self.policy = PortfolioRingTransformerPolicy(
-                n_pairs=n_pairs, window=env.cfg.window_size
+                n_pairs=n_pairs, window=env.cfg.window_size,
+                sp_backend="ulysses" if pcfg.policy == "transformer_ulysses"
+                else "ring",
             )
         elif pcfg.policy == "mlp":
             self.policy = PortfolioMLPPolicy(n_pairs=n_pairs)
         else:
             raise ValueError(
                 f"portfolio trainer supports policy "
-                f"mlp|transformer|transformer_ring, got {pcfg.policy!r}"
+                f"mlp|transformer|transformer_ring|transformer_ulysses, "
+                f"got {pcfg.policy!r}"
             )
         self.optimizer = self._make_optimizer()
         self._reset_state, reset_obs = P.reset(env.cfg, env.params, env.data)

@@ -1,0 +1,155 @@
+"""Ulysses (all-to-all) sequence parallelism: the second SP backend.
+
+Same contract as the ring suite: exactness against the full-attention
+oracle on the virtual 8-device CPU mesh, head-divisibility validation,
+the transformer_ulysses policy matching its single-device forward, and
+training under PPO.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from gymfx_tpu.parallel import make_mesh
+from gymfx_tpu.parallel.ring_attention import full_attention
+from gymfx_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_inner,
+)
+from gymfx_tpu.train.policies import (
+    make_policy,
+    seq_sharded_forward,
+)
+
+N_DEV = len(jax.devices())
+
+
+def _qkv(s=64, h=8, d=16, seed=0, batch=None):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (s, h, d) if batch is None else (batch, s, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full_attention(causal):
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv()
+    ours = ulysses_attention(q, k, v, mesh=mesh, axis="seq", causal=causal)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_on_smaller_axis():
+    mesh = make_mesh({"seq": 4, "data": 2})
+    q, k, v = _qkv(s=32, h=4, d=8, seed=3)
+    ours = ulysses_attention(q, k, v, mesh=mesh, axis="seq")
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_heads_must_divide():
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv(h=4)  # 4 heads over 8 shards
+    with pytest.raises(ValueError, match="n_heads"):
+        ulysses_attention(q, k, v, mesh=mesh, axis="seq")
+
+
+def test_ulysses_uneven_sequence_rejected():
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv(s=60)
+    with pytest.raises(ValueError, match="divide"):
+        ulysses_attention(q, k, v, mesh=mesh)
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs a multi-device (CPU) mesh")
+def test_batched_ulysses_inner_matches_full():
+    """ulysses_attention_inner with leading batch dims inside an
+    explicit shard_map, against the batched full-attention oracle."""
+    window = 4 * N_DEV
+    q, k, v = _qkv(s=window, h=N_DEV, d=8, seed=3, batch=3)
+    mesh = make_mesh({"seq": N_DEV})
+    spec = P(None, "seq", None, None)
+
+    def f(qb, kb, vb):
+        return ulysses_attention_inner(
+            qb, kb, vb, axis="seq", n_shards=N_DEV, causal=True
+        )
+
+    out = jax.shard_map(
+        f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+    ref = full_attention(q, k, v, causal=True)
+    assert out.shape == ref.shape
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs a multi-device (CPU) mesh")
+def test_ulysses_policy_seq_sharded_forward_matches_single_device():
+    window = 8 * N_DEV
+    policy = make_policy(
+        "transformer_ulysses", window=window, d_model=32,
+        n_heads=N_DEV, n_layers=2,
+    )
+    assert policy.sp_backend == "ulysses"
+    tokens = jax.random.normal(jax.random.PRNGKey(0), (4, window, 12))
+    params = policy.init(jax.random.PRNGKey(1), tokens[0])
+
+    logits_ref, value_ref = jax.vmap(lambda t: policy.apply(params, t))(tokens)
+    mesh = make_mesh({"seq": N_DEV})
+    logits_sp, value_sp = seq_sharded_forward(policy, params, tokens, mesh)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_sp), np.asarray(logits_ref), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(value_sp), np.asarray(value_ref), atol=2e-5
+    )
+
+
+def test_ppo_trains_with_transformer_ulysses_policy():
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    config = dict(
+        DEFAULT_VALUES,
+        input_data_file="examples/data/eurusd_sample.csv",
+        num_envs=4,
+        policy="transformer_ulysses",
+        ppo_horizon=8,
+        ppo_epochs=1,
+        ppo_minibatches=2,
+    )
+    env = Environment(config)
+    trainer = PPOTrainer(env, ppo_config_from(config))
+    state = trainer.init_state(0)
+    state, metrics = trainer.train_step(state)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_portfolio_trainer_accepts_ulysses_policy():
+    from gymfx_tpu.train.portfolio_ppo import (
+        PortfolioPPOConfig,
+        PortfolioPPOTrainer,
+    )
+    from gymfx_tpu.core import portfolio as P_
+
+    config = {
+        "portfolio_files": {
+            "EUR_USD": "examples/data/eurusd_sample.csv",
+            "GBP_USD": "examples/data/gbpusd_sample.csv",
+        },
+        "initial_cash": 10000.0,
+        "position_size": 1000.0,
+    }
+    env = P_.PortfolioEnvironment(config)
+    trainer = PortfolioPPOTrainer(
+        env, PortfolioPPOConfig(n_envs=2, horizon=4, epochs=1, minibatches=1,
+                                policy="transformer_ulysses"),
+    )
+    assert trainer.policy.sp_backend == "ulysses"
+    state = trainer.init_state(0)
+    state, metrics = trainer.train_step(state)
+    assert np.isfinite(float(metrics["loss"]))

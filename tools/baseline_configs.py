@@ -157,13 +157,16 @@ def config_5_portfolio_pbt(quick: bool):
         )
     )
     pbt = summary["pbt"]
+    fitness = pbt.get("fitness") or []
     return {
         "trainer": "pbt_portfolio",
         "policy": "transformer",
         "pairs": ["EUR_USD", "GBP_USD", "USD_JPY"],
         "population": population,
         "total_env_steps": pbt.get("total_env_steps"),
-        "best_fitness": pbt.get("best_fitness"),
+        "env_steps_per_sec": pbt.get("env_steps_per_sec"),
+        "best_member": pbt.get("best_member"),
+        "best_fitness": max(fitness) if fitness else None,
     }
 
 
