@@ -176,6 +176,11 @@ class RingTransformerEncoder(nn.Module):
         )
         from gymfx_tpu.parallel.ulysses import ulysses_attention_inner
 
+        if self.sp_backend not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown sp_backend {self.sp_backend!r} "
+                "(expected 'ring' or 'ulysses')"
+            )
         head_dim = self.d_model // self.n_heads
         x = nn.Dense(self.d_model, dtype=self.dtype)(tokens.astype(self.dtype))
         pos = self.param(

@@ -262,7 +262,13 @@ class PPOTrainer:
             obs_vec2 = masked_reset(done, reset_vec, obs_vec2)
             pcarry2 = masked_reset(done, carry0, pcarry2)
             out = dict(
-                obs=obs_vec, action=action, logp=logp, value=value,
+                # store obs in the policy's compute dtype: every policy
+                # casts its input to that dtype at entry, so the replay
+                # sees bit-identical inputs while the (T*N, obs_dim)
+                # minibatch buffer (the update's HBM hot spot) halves
+                # under bf16
+                obs=obs_vec.astype(self.pcfg.policy_dtype),
+                action=action, logp=logp, value=value,
                 reward=reward.astype(jnp.float32), done=done,
                 # the carry that ENTERED this step — replayed during the
                 # minibatch passes so recurrent policies see exactly the

@@ -338,3 +338,55 @@ def test_ppo_lstm_stored_state_replay_is_exact():
     )[:, 0]
     stored_logp = traj["logp"].reshape(n_total)
     assert float(jnp.max(jnp.abs(replay_logp - stored_logp))) < 1e-6
+
+
+def test_ppo_bf16_policy_dtype_trains_and_stores_bf16_obs():
+    """policy_dtype=bfloat16: the trajectory obs buffer is stored in the
+    policy compute dtype (the minibatch-replay HBM optimization) and the
+    first-epoch replayed log-probs still match the stored ones exactly,
+    because every policy casts its input to its dtype at entry."""
+    import jax
+    import jax.numpy as jnp
+
+    tr = _trainer(num_envs=4, ppo_horizon=8, policy_dtype="bfloat16")
+    assert tr.pcfg.policy_dtype == jnp.bfloat16
+    s = tr.init_state(0)
+
+    _, _, _, _, traj, _ = jax.jit(
+        lambda st: tr._rollout(
+            st.params, st.env_states, st.obs_vec, st.policy_carry, st.rng
+        )
+    )(s)
+    assert traj["obs"].dtype == jnp.bfloat16
+    # replaying the stored (bf16) obs through the policy reproduces the
+    # rollout's log-probs up to bf16 compile noise (a wrong-input bug —
+    # e.g. double-rounding or a policy without an entry cast — would be
+    # off by O(1), not O(1e-2))
+    dist, _, _ = jax.vmap(
+        lambda o, c: tr._policy_forward(s.params, o, c), in_axes=(0, 0)
+    )(traj["obs"][0], jax.tree.map(lambda x: x[0], traj["pcarry"]))
+    logp = jnp.take_along_axis(
+        jax.nn.log_softmax(dist), traj["action"][0][:, None], axis=1
+    )[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(logp, np.float64), np.asarray(traj["logp"][0], np.float64),
+        atol=2e-2,
+    )
+
+    s, metrics = tr.train_step(s)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_unknown_sp_backend_rejected():
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from gymfx_tpu.train.policies import RingTransformerPolicy, with_seq_sharding
+
+    policy = RingTransformerPolicy(window=8, d_model=16, n_heads=2,
+                                   n_layers=1, sp_backend="Ulysses")
+    sharded = with_seq_sharding(policy, "seq", 1)
+    tokens = jnp.zeros((8, 4))
+    with _pytest.raises(ValueError, match="sp_backend"):
+        sharded.init(jax.random.PRNGKey(0), tokens)
