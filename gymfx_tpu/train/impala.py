@@ -191,7 +191,11 @@ class ImpalaTrainer:
             obs_vec2 = masked_reset(done, reset_vec, obs_vec2)
             pcarry2 = masked_reset(done, carry0, pcarry2)
             out = dict(
-                obs=obs_vec, action=action, mu_logp=logp,
+                # obs stored in the policy compute dtype (bit-identical
+                # policy inputs — every policy casts at entry; halves
+                # the learner-pass HBM buffer under bf16, train/ppo.py)
+                obs=obs_vec.astype(self.icfg.policy_dtype),
+                action=action, mu_logp=logp,
                 reward=reward.astype(jnp.float32), done=done,
             )
             return (env_states2, obs_vec2, pcarry2, rng), out

@@ -265,3 +265,30 @@ def test_custom_kernels_work_in_portfolio(tmp_path):
     for _ in range(3):
         s, obs, r, d, info = env.step(s, np.zeros(1, np.int32))
     assert np.asarray(s.pairs.pos).tolist() == [3.0]
+
+
+def test_oanda_broker_stub_gating(monkeypatch):
+    """The live-broker stub is hard-gated exactly like the reference
+    (reference broker_plugins/oanda_broker.py:43-46): without the
+    acknowledgement env var it refuses; with it but without credentials
+    it demands them; with both it stops at the not-implemented routing
+    boundary (no live trading from a simulation framework)."""
+    import pytest
+
+    from gymfx_tpu.plugins.registry import load_plugin
+
+    plugin, params = load_plugin("broker.plugins", "oanda_broker")
+    assert "oanda_token" in params and "oanda_instrument" in params
+
+    monkeypatch.delenv("GYMFX_ENABLE_LIVE", raising=False)
+    with pytest.raises(RuntimeError, match="GYMFX_ENABLE_LIVE"):
+        plugin({})
+
+    monkeypatch.setenv("GYMFX_ENABLE_LIVE", "1")
+    monkeypatch.delenv("OANDA_TOKEN", raising=False)
+    monkeypatch.delenv("OANDA_ACCOUNT_ID", raising=False)
+    with pytest.raises(ValueError, match="oanda_token"):
+        plugin({})
+
+    with pytest.raises(NotImplementedError):
+        plugin({"oanda_token": "t", "oanda_account_id": "a"})
