@@ -309,10 +309,10 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
         try:
             summary["execution_crosscheck"] = crosscheck_episode(
                 config,
-                np.asarray(out["action"])[:n_steps].tolist(),
                 seed=seed,
                 env=env,
                 scan_state=state,
+                trace=out,
                 terminated=bankrupt,
             )
         except (ValueError, TypeError) as exc:

@@ -118,6 +118,15 @@ def _make_scan_body(cfg, params, data, driver, collect, offset):
                 "position": jnp.sign(state.pos).astype(jnp.int32),
                 "trade_count": state.trade_count,
                 "bar_index": state.t + 1,
+                # the pending order this step recorded (fills at the
+                # NEXT bar's open) — the decision stream the replay
+                # cross-check re-executes, incl. bracket prices
+                # (simulation/crosscheck.py)
+                "pending_active": state.pending_active,
+                "pending_target": state.pending_target,
+                "pending_sl": state.pending_sl,
+                "pending_tp": state.pending_tp,
+                "pos_units": state.pos,
             }
             if cfg.event_context_execution_overlay:
                 out["event_context"] = {

@@ -2,39 +2,50 @@
 
 The role the Nautilus-backed env plays in the reference — an
 independent engine verifying the training env's execution — done the
-TPU-framework way: run one episode's action stream through BOTH engines
-and reconcile their realized balances.
+TPU-framework way: re-execute one scan episode's DECISION STREAM (the
+pending orders the strategy recorded, including bracket SL/TP prices)
+through the float64 replay engine and reconcile realized balances.
 
   * the SCAN engine (core/broker.py) is the throughput path: pending
-    market orders fill at the next bar's open, displaced adversely by
-    the profile rate, commission per side (reference timing:
-    backtrader's cheat-on-open=False next-bar-open fills);
+    market orders fill at the next bar's open, brackets resolve
+    intrabar against H/L under the profile's collision policy;
   * the REPLAY engine (simulation/replay.py) is the verification twin.
-    Its latency model makes the timing line up exactly: a target
+    Its latency model makes order timing line up exactly: a target
     submitted with ``latency_ms == one bar interval`` fills at the
-    FIRST path tick of the next frame — the next bar's open — which is
-    the scan engine's fill rule.
+    FIRST path tick of the next frame — the next bar's open, the scan
+    engine's fill rule.  Same-bar bracket arming matches too (fills
+    flush before the path walk).
+
+Working from the decision stream (``pending_active/target/sl/tp`` in
+the rollout trace) rather than raw actions means EVERY strategy kernel
+is verifiable — default flow, fixed/ATR brackets, third-party
+registered kernels, continuous action mode, event overlays — because
+the stream records what the strategy decided, not how it decided it.
+
+Intrabar path construction: the scan models continuous intrabar
+movement (a stop at S inside the bar's range fills at S), so each
+frame's execution path walks the bar's legs in the collision-policy
+order (worst_case: adverse extreme first for the held position; ohlc:
+O->H->L->C) with the armed bracket levels inserted as explicit ticks —
+the replay then triggers at the same price the scan did.  A bar that
+gaps open through a bracket fills at the open in both engines.
 
 The instrument is resolved from the layered config through
 ``contracts.instrument_spec_from_config`` (the reference's env-side
-resolver, simulation_engines/nautilus_gym.py:34-51), so
-``instrument`` / ``price_precision`` / ``size_precision`` /
-``min_quantity`` / ``margin_init`` config keys drive the verification
-venue.  Venue quantization (DIVERGENCES.md #9d) means a fractional
-``position_size`` under ``size_precision=0`` shows up here as a
-divergence — which is the point: the cross-check makes the engines'
-differences measurable instead of assumed.
+resolver, simulation_engines/nautilus_gym.py:34-51).  Venue
+quantization (DIVERGENCES.md #9d) means fractional sizes under
+``size_precision=0`` show up here as bounded divergence — set
+``size_precision``/``min_quantity`` in the config when cross-checking
+fractional-unit strategies.
 
-Scope (v1): ``strategy_plugin`` = default flow (market orders,
-long/short/flip/flat — no brackets), event overlay off, financing off.
-Bracketed strategies need SL/TP price reconstruction from indicator
-state and are verified instead by the fixture suites
-(tests/test_brackets.py, tests/test_execution_profile.py).
+Out of scope: financing (the per-bar scan accrual vs per-event replay
+accrual is cross-checked to the cent by tests/test_execution_profile)
+and bankrupt episodes (the scan freezes at termination mid-stream).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -57,7 +68,8 @@ def _profile_for_replay(config: Dict[str, Any], bar_ms: float) -> ExecutionCostP
     if profile is None:
         # key resolution mirrors the scan engine's (core/types.py
         # make_env_params): slippage_perc (default_broker's param) wins
-        # over the bare slippage key
+        # over the bare slippage key; the scan's no-profile default
+        # limit policy is "cross" (make_env_config)
         slippage = float(
             config.get("slippage_perc", config.get("slippage", 0.0)) or 0.0
         )
@@ -69,8 +81,10 @@ def _profile_for_replay(config: Dict[str, Any], bar_ms: float) -> ExecutionCostP
             slippage_bps_per_side=slippage * 1e4,
             latency_ms=0,
             financing_enabled=False,
-            intrabar_collision_policy="worst_case",
-            limit_fill_policy="conservative",
+            intrabar_collision_policy=str(
+                config.get("intrabar_collision_policy", "worst_case")
+            ),
+            limit_fill_policy=str(config.get("limit_fill_policy", "cross")),
             margin_model="leveraged",
             enforce_margin_preflight=False,
             random_seed=0,
@@ -78,31 +92,34 @@ def _profile_for_replay(config: Dict[str, Any], bar_ms: float) -> ExecutionCostP
     return dataclasses.replace(profile, latency_ms=int(round(bar_ms)))
 
 
-def _targets_from_actions(
-    actions: Sequence[int], position_size: float, allow_flat: bool
-) -> List[Optional[float]]:
-    """Default-flow intent tracking (core/strategy.py:_default_flow):
-    1 -> +size when pos <= 0, 2 -> -size when pos >= 0, 3 -> flat
-    (coerced to hold unless allow_flat_action, core/env.py action
-    coercion), 0/ineffective -> no order.  Returns a target per step or
-    None."""
-    cur = 0.0
-    targets: List[Optional[float]] = []
-    for a in actions:
-        a = int(a)
-        if a == 3 and not allow_flat:
-            a = 0  # the env coerces out-of-range actions to hold
-        target: Optional[float] = None
-        if a == 1 and cur <= 0:
-            target = position_size
-        elif a == 2 and cur >= 0:
-            target = -position_size
-        elif a == 3 and cur != 0:
-            target = 0.0
-        targets.append(target)
-        if target is not None:
-            cur = target
-    return targets
+def _build_path(
+    o: float, h: float, l: float, c: float,
+    walk_pos: float, levels: Sequence[float], ohlc_order: bool,
+) -> Tuple[float, ...]:
+    """One bar's execution path: its legs in collision order, with the
+    armed bracket levels inserted as explicit ticks (clamped to the
+    leg) so triggers happen at the same prices the scan engine uses.
+
+    worst_case for a LONG walks the adverse (low) leg first: O->L->H->C;
+    for a short (or under the ohlc policy) the bar walks O->H->L->C.
+    """
+    if ohlc_order or walk_pos <= 0:
+        legs = [(o, h), (h, l), (l, c)]
+    else:
+        legs = [(o, l), (l, h), (h, c)]
+    path: List[float] = [o]
+    lvls = [x for x in levels if x > 0.0]
+    for a, b in legs:
+        inner = [x for x in lvls if min(a, b) < x < max(a, b)]
+        inner.sort(reverse=a > b)
+        for x in inner:
+            path.append(x)
+        path.append(b)
+    deduped: List[float] = [path[0]]
+    for x in path[1:]:
+        if x != deduped[-1]:
+            deduped.append(x)
+    return tuple(deduped)
 
 
 def crosscheck_episode(
@@ -113,46 +130,32 @@ def crosscheck_episode(
     seed: int = 0,
     env: Optional[Any] = None,
     scan_state: Optional[Any] = None,
+    trace: Optional[Dict[str, Any]] = None,
     terminated: bool = False,
 ) -> Dict[str, Any]:
     """Run one episode through both engines; return both balances.
 
-    ``actions``: explicit action stream; default = the config's driver
-    (driver_mode) generates it on the scan side and the executed stream
-    is replayed.  Callers that already ran the scan episode (the CLI's
-    ``--verify_execution`` path) pass their ``env`` + final
-    ``scan_state`` (+ ``terminated``) to skip the duplicate rollout.
-    Returns scan/replay realized balances, divergence, the replay
-    result hashes, and the per-engine fill counts.
+    Three entry modes:
+      * default — the config's driver (driver_mode) runs one scan
+        episode and its decision stream is re-executed;
+      * ``actions`` — an explicit action stream is run through the scan
+        engine first, then its decision stream re-executed;
+      * ``scan_state`` + ``trace`` (+ ``terminated``) — the caller (the
+        CLI's ``--verify_execution`` path) already ran the episode;
+        nothing is re-run on the scan side.
+    Returns scan/replay realized balances, divergence with its
+    quantization bound, replay hashes, and fill counts.
     """
     from gymfx_tpu.core import broker
     from gymfx_tpu.core.rollout import replay_driver
     from gymfx_tpu.core.runtime import Environment
-    from gymfx_tpu.simulation.replay import ReplayAdapter
 
     config = dict(config)
-    if str(config.get("strategy_plugin", "default_strategy")) not in (
-        "default_strategy",
-        "default",
-    ):
-        raise ValueError(
-            "crosscheck v1 verifies the default market-order flow; bracketed "
-            "strategies are verified by the fixture suites"
-        )
-    if config.get("event_context_execution_overlay"):
-        raise ValueError("crosscheck requires the event overlay disabled")
-    if str(config.get("action_space_mode", "discrete")).lower() == "continuous":
-        raise ValueError(
-            "crosscheck v1 requires discrete actions: the recorded action "
-            "stream stores raw continuous values truncated to int, which "
-            "cannot reconstruct the env's thresholded intents"
-        )
-
     if env is None:
         env = Environment(config)
     if env.cfg.financing_enabled:
         raise ValueError(
-            "crosscheck v1 does not model financing; disable financing_enabled "
+            "crosscheck does not model financing; disable financing_enabled "
             "(both engines' financing is cross-checked by "
             "tests/test_execution_profile.py)"
         )
@@ -162,49 +165,49 @@ def crosscheck_episode(
 
     n_bars = env.n_bars
 
-    def normalize(raw: Sequence[int], cap: int) -> List[int]:
-        return [int(a) for a in raw][: min(len(raw), cap)]
-
     def raise_if_terminated(done_any: bool) -> None:
         if done_any:
             raise ValueError(
                 "episode terminated early (bankruptcy); crosscheck needs the "
-                "full action stream to execute in both engines"
+                "full decision stream to execute in both engines"
             )
 
     if scan_state is not None:
-        # the caller already ran the scan episode — reuse its outcome.
-        # No n_bars-2 cap: the caller's episode may have run right up to
-        # exhaustion (t == n_bars-1); actions past bar n_bars-1 were
-        # never seen by the strategy (exhausted steps don't act).
-        if actions is None:
-            raise ValueError("scan_state requires the executed action stream")
+        if trace is None:
+            raise ValueError("scan_state requires the collected rollout trace")
         raise_if_terminated(terminated)
-        actions = normalize(actions, n_bars)
         state = jax.device_get(scan_state)
+        trace = jax.device_get(trace)
     else:
         if actions is None:
             driver = env.make_driver()
             n_steps = min(int(steps or config.get("steps", 500)), n_bars - 2)
-            state, out = env.rollout(driver, n_steps, seed=seed)
-            actions = np.asarray(out["action"])[:n_steps].tolist()
+            state, trace = env.rollout(driver, n_steps, seed=seed)
         else:
-            actions = normalize(actions, n_bars - 2)
-            state, out = env.rollout(
-                replay_driver(np.asarray(actions)), len(actions), seed=seed
+            acts = [int(a) for a in actions][: n_bars - 2]
+            state, trace = env.rollout(
+                replay_driver(np.asarray(acts)), len(acts), seed=seed
             )
-        state = jax.device_get(state)
-        raise_if_terminated(bool(np.asarray(jax.device_get(out["done"]), bool).any()))
-    n_steps = len(actions)
-    scan_balance = float(
-        np.asarray(broker.realized_balance(state, env.params))
-    )
+        state, trace = jax.device_get((state, trace))
+        raise_if_terminated(bool(np.asarray(trace["done"], bool).any()))
 
-    # replay side: frames are the dataset bars; scan step i processes
-    # bar i (step 0 is the warmup on bar 0), so the action taken at step
-    # i is submitted on frame i and the one-bar latency fills it at bar
-    # i+1's first path tick — the bar's open, the scan engine's rule
+    pend_active = np.asarray(trace["pending_active"], bool)
+    pend_target = np.asarray(trace["pending_target"], np.float64)
+    pend_sl = np.asarray(trace["pending_sl"], np.float64)
+    pend_tp = np.asarray(trace["pending_tp"], np.float64)
+    pos_units = np.asarray(trace["pos_units"], np.float64)
+    # cap at n_bars: a longer trace ran past exhaustion, where steps are
+    # no-ops (the strategy never acts on bars that do not exist)
+    n_steps = min(len(pend_active), n_bars)
+
+    scan_balance = float(np.asarray(broker.realized_balance(state, env.params)))
+
+    # replay side: scan step i processes bar i (step 0 is the warmup on
+    # bar 0), so the pending order recorded at step i is submitted on
+    # frame i and the one-bar latency fills it at bar i+1's first path
+    # tick — the bar's open, the scan engine's rule
     spec = instrument_spec_from_config(config)
+    profile = _profile_for_replay(config, bar_ms)
     ts = env.dataset.timestamps.to_numpy().astype("datetime64[ns]").astype(np.int64)
     # the same (compute-dtype) price arrays the scan engine executed on,
     # so the comparison isolates engine semantics, not float width
@@ -212,40 +215,57 @@ def crosscheck_episode(
     h = np.asarray(jax.device_get(env.data.high), np.float64)
     l = np.asarray(jax.device_get(env.data.low), np.float64)
     c = np.asarray(jax.device_get(env.data.close), np.float64)
-    frames = [
-        MarketFrame(
-            instrument_id=spec.instrument_id,
-            timeframe_minutes=max(1, int(round(bar_ms / 60_000.0))),
-            ts_event_ns=int(ts[j]),
-            open=float(o[j]),
-            high=float(h[j]),
-            low=float(l[j]),
-            close=float(c[j]),
-            volume=0.0,
-            execution_path=(float(o[j]), float(h[j]), float(l[j]), float(c[j])),
+
+    ohlc_order = env.cfg.intrabar_collision_policy == "ohlc"
+    frames: List[MarketFrame] = []
+    levels: Tuple[float, float] = (0.0, 0.0)
+    # frames stop at bar n_steps-1, the last bar the scan episode
+    # processed: its final pending order never fills (the episode ends
+    # first), so the replay twin leaves it in flight too
+    for j in range(min(n_steps, n_bars)):
+        if j == 0:
+            walk_pos = 0.0
+        elif pend_active[j - 1]:
+            walk_pos = float(pend_target[j - 1])
+        else:
+            walk_pos = float(pos_units[j - 1])
+        frames.append(
+            MarketFrame(
+                instrument_id=spec.instrument_id,
+                timeframe_minutes=max(1, int(round(bar_ms / 60_000.0))),
+                ts_event_ns=int(ts[j]),
+                open=float(o[j]),
+                high=float(h[j]),
+                low=float(l[j]),
+                close=float(c[j]),
+                volume=0.0,
+                execution_path=_build_path(
+                    float(o[j]), float(h[j]), float(l[j]), float(c[j]),
+                    walk_pos, levels, ohlc_order,
+                ),
+            )
         )
-        # frames stop at bar n_steps-1, the last bar the scan episode
-        # processed: its final pending order never fills (the episode
-        # ends first), so the replay twin leaves it in flight too
-        # (orders_pending_unexecuted)
-        for j in range(min(n_steps, n_bars))
-    ]
-    position_size = float(config.get("position_size", 1.0) or 1.0)
-    targets = _targets_from_actions(
-        actions, position_size, bool(env.cfg.allow_flat_action)
-    )
+        # only the most recent bracket-carrying order can be armed
+        # (brackets arm on entry fills and clear on flat/flip), so its
+        # levels are the only candidate trigger prices for later bars
+        if pend_active[j] and (pend_sl[j] > 0.0 or pend_tp[j] > 0.0):
+            levels = (float(pend_sl[j]), float(pend_tp[j]))
+
     target_actions = [
         TargetAction(
             instrument_id=spec.instrument_id,
             ts_event_ns=int(ts[i]),
-            target_units=t,
+            target_units=float(pend_target[i]),
             action_id=f"step-{i}",
+            stop_loss_price=float(pend_sl[i]) if pend_sl[i] > 0.0 else None,
+            take_profit_price=float(pend_tp[i]) if pend_tp[i] > 0.0 else None,
         )
-        for i, t in enumerate(targets)
-        if t is not None
+        for i in range(n_steps)
+        if pend_active[i]
     ]
 
-    profile = _profile_for_replay(config, bar_ms)
+    from gymfx_tpu.simulation.replay import ReplayAdapter
+
     initial_cash = float(config.get("initial_cash", 10000.0) or 10000.0)
     result = ReplayAdapter(profile).run(
         instrument_specs=[spec],
@@ -260,21 +280,29 @@ def crosscheck_episode(
 
     # the replay venue quotes at price_precision (like the reference's
     # Nautilus book) while the scan engine fills at unquantized floats:
-    # each fill can differ by up to half a tick per unit, so the
-    # expected agreement bound is fills * units * tick/2 (+ f32 noise)
-    tick = 10.0 ** (-spec.price_precision)
-    # dtype rounding term scaled to the scan engine's actual compute
-    # dtype (f32 ~1e-7 relative, bf16 ~4e-3 — both supported dtypes)
+    # each fill can differ by up to half a tick per unit, plus the scan
+    # compute dtype's rounding (f32 ~1e-7 relative, bf16 ~4e-3); under
+    # limit_fill_policy=cross with a nonzero adverse rate the two
+    # engines price TP touches differently (limit price vs touching
+    # tick's book) by up to the adverse displacement per unit
     import jax.numpy as jnp
 
-    dtype_eps = 3.0 * float(jnp.finfo(env.cfg.dtype).eps) * float(np.max(c))
+    tick = 10.0 ** (-spec.price_precision)
+    max_price = float(np.max(c))
+    dtype_eps = 3.0 * float(jnp.finfo(env.cfg.dtype).eps) * max_price
+    per_unit = tick / 2.0 + dtype_eps
+    if (
+        profile.limit_fill_policy == "cross"
+        and profile.quote_adverse_rate_per_side > 0
+    ):
+        per_unit += profile.quote_adverse_rate_per_side * max_price
     filled_units = sum(float(f["quantity"]) for f in fills)
-    quantization_bound = filled_units * (tick / 2.0 + dtype_eps) + 0.01
+    quantization_bound = filled_units * per_unit + 0.01
 
     return {
-        "schema": "scan_replay_crosscheck.v1",
+        "schema": "scan_replay_crosscheck.v2",
         "instrument": spec.instrument_id,
-        "steps": n_steps,
+        "steps": int(n_steps),
         "actions_submitted": len(target_actions),
         "scan_realized_balance": scan_balance,
         "replay_final_balance": replay_balance,

@@ -363,7 +363,13 @@ class ReplayAdapter:
             if sl_hit:
                 if not fill_model.stop_fills():
                     return
-                exit_price = sl
+                # a triggered stop becomes a market order at the current
+                # book: when the market gapped through the stop (e.g. a
+                # bar opening beyond it), the fill is the gapped book
+                # price, not the stop price — Nautilus stop->market
+                # semantics and the scan engine's gap-fill-at-open
+                # (core/broker.py check_brackets)
+                exit_price = min(sl, bid) if long else max(sl, ask)
             else:
                 if not fill_model.limit_fills():
                     return
@@ -513,12 +519,16 @@ class ReplayAdapter:
                 )
                 continue
 
+            # units this order would OPEN (fresh entry, add, or the
+            # opening leg of a flip) — drives both the margin preflight
+            # and bracket arming
+            opening = 0.0
+            if current == 0 or current * delta > 0:
+                opening = qty
+            elif qty > abs(current):
+                opening = qty - abs(current)
+
             if profile.enforce_margin_preflight:
-                opening = 0.0
-                if current == 0 or current * delta > 0:
-                    opening = qty
-                elif qty > abs(current):
-                    opening = qty - abs(current)
                 if opening > 0:
                     notional_quote = opening * mid
                     required_quote = notional_quote * float(spec.margin_init)
@@ -542,8 +552,13 @@ class ReplayAdapter:
             order_seq += 1
             order_count += 1
             order_id = f"O-{order_seq}"
+            # brackets arm whenever the fill OPENS units (fresh entry or
+            # the opening leg of a flip) and both prices are present —
+            # the scan kernel's `entered` semantics (core/broker.py
+            # fill_pending); the reference's scripted strategy only
+            # brackets from flat, a strict subset of this behavior
             wants_brackets = (
-                current == 0
+                opening > 0
                 and action.stop_loss_price is not None
                 and action.take_profit_price is not None
             )

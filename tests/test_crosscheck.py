@@ -81,11 +81,7 @@ def test_final_pending_order_left_in_flight_in_both_engines():
     assert result["divergence"] <= 1e-9
 
 
-def test_bracket_strategies_and_financing_rejected():
-    with pytest.raises(ValueError, match="default market-order flow"):
-        crosscheck_episode(_config(strategy_plugin="direct_atr_sltp"), [0])
-    import dataclasses
-
+def test_financing_rejected():
     profile = dict(PROFILE, financing_enabled=True)
     config = _config(
         execution_cost_profile=profile,
@@ -93,6 +89,74 @@ def test_bracket_strategies_and_financing_rejected():
     )
     with pytest.raises(ValueError, match="financing"):
         crosscheck_episode(config, [0])
+
+
+# ---------------------------------------------------------------------------
+# bracketed strategies: the decision stream carries SL/TP, the replay
+# engine re-arms and re-resolves them against constructed intrabar paths
+# ---------------------------------------------------------------------------
+def test_fixed_sltp_bracket_episode_reconciles():
+    result = crosscheck_episode(
+        _config(
+            driver_mode="random",
+            steps=300,
+            strategy_plugin="direct_fixed_sltp",
+            sl_pips=10.0,
+            tp_pips=20.0,
+        ),
+        seed=5,
+    )
+    assert result["replay_fills"] > 20  # entries AND bracket exits
+    assert result["within_bound"], result
+    assert result["divergence"] <= 0.05
+
+
+def test_fixed_sltp_bracket_episode_reconciles_with_costs():
+    result = crosscheck_episode(
+        _config(
+            driver_mode="random",
+            steps=300,
+            strategy_plugin="direct_fixed_sltp",
+            sl_pips=10.0,
+            tp_pips=20.0,
+            execution_cost_profile=PROFILE,
+        ),
+        seed=5,
+    )
+    assert result["replay_fills"] > 20
+    assert result["within_bound"], result
+
+
+def test_atr_sltp_bracket_episode_reconciles():
+    """The flagship ATR strategy: fractional sizes need a fine venue
+    size grid (size_precision) for tight reconciliation."""
+    result = crosscheck_episode(
+        _config(
+            driver_mode="random",
+            steps=300,
+            strategy_plugin="direct_atr_sltp",
+            atr_period=5,
+            k_sl=1.5,
+            k_tp=3.0,
+            rel_volume=0.2,
+            leverage=10.0,
+            size_precision=6,
+            min_quantity=1e-6,
+        ),
+        seed=2,
+    )
+    assert result["replay_fills"] >= 3
+    assert result["within_bound"], result
+
+
+def test_continuous_action_mode_reconciles():
+    """Continuous mode works through the decision stream — the pending
+    orders record the thresholded intents, not the raw floats."""
+    result = crosscheck_episode(
+        _config(driver_mode="random", steps=200, action_space_mode="continuous"),
+        seed=4,
+    )
+    assert result["within_bound"], result
 
 
 def test_cli_verify_execution_flag():
@@ -108,7 +172,7 @@ def test_cli_verify_execution_flag():
         )
     )
     cc = summary["execution_crosscheck"]
-    assert cc["schema"] == "scan_replay_crosscheck.v1"
+    assert cc["schema"] == "scan_replay_crosscheck.v2"
     assert cc["within_bound"]
     assert cc["steps"] == 120
 
@@ -152,14 +216,16 @@ def test_cli_verify_execution_exhausted_episode_still_verifies():
 
 
 def test_cli_verify_execution_unsupported_config_records_skip():
-    """An unsupported crosscheck config must not abort a finished run."""
+    """An unsupported crosscheck config (financing) must not abort a
+    finished run — it records a skip."""
     from gymfx_tpu.app.main import _run_env
 
     summary = _run_env(
         _config(
             driver_mode="random",
             steps=60,
-            strategy_plugin="direct_atr_sltp",
+            execution_cost_profile=dict(PROFILE, financing_enabled=True),
+            financing_rate_data_file="examples/data/fx_rollover_rates_smoke.csv",
             verify_execution=True,
             results_file=None,
             save_config=None,
@@ -167,5 +233,5 @@ def test_cli_verify_execution_unsupported_config_records_skip():
     )
     cc = summary["execution_crosscheck"]
     assert cc["status"] == "skipped"
-    assert "default market-order flow" in cc["reason"]
+    assert "financing" in cc["reason"]
     assert "total_return" in summary  # the run itself still completed
