@@ -18,6 +18,10 @@ from typing import Any, Dict, List
 
 import numpy as np
 
+from gymfx_tpu.parallel import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
 from gymfx_tpu.config import DEFAULT_VALUES, load_config, merge_config, save_config
 from gymfx_tpu.config.cli import parse_args
 from gymfx_tpu.config.merger import process_unknown_args

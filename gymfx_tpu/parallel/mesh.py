@@ -20,6 +20,19 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
+def honor_jax_platforms_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu`` win even when a sitecustomize
+    force-registers an accelerator plugin (plugin registration overrides
+    the env var; the config update overrides the registration; harmless
+    when already honored).  Without this a user-requested virtual
+    multi-device CPU mesh (--xla_force_host_platform_device_count)
+    never forms.  Shared by the CLI and the driver entry points."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+
 def make_mesh(
     shape: Optional[Dict[str, int]] = None,
     *,
@@ -42,6 +55,66 @@ def make_mesh(
         )
     grid = np.array(devices[:need]).reshape(sizes)
     return Mesh(grid, axis_names)
+
+
+def mesh_from_config(config: Dict) -> Optional[Mesh]:
+    """Resolve the ``mesh_shape`` config key into a live Mesh (or None).
+
+    Honor-or-reject: accepts a dict (config file) or a JSON string (CLI
+    passthrough), validates axis names/sizes, and raises when the shape
+    cannot be realized on the available devices — never silently ignores
+    the field.  ``n_envs`` divisibility is validated by the trainers
+    (they know their batch axis).
+    """
+    raw = config.get("mesh_shape")
+    if raw is None or raw == "":
+        return None
+    if isinstance(raw, str):
+        import json
+
+        try:
+            raw = json.loads(raw)
+        except json.JSONDecodeError as exc:
+            raise ValueError(
+                f"mesh_shape must be a JSON object like "
+                f'{{"data": 4, "model": 2}}; got {raw!r}'
+            ) from exc
+    if not isinstance(raw, dict) or not raw:
+        raise ValueError(f"mesh_shape must be a non-empty mapping, got {raw!r}")
+    shape: Dict[str, int] = {}
+    for axis, size in raw.items():
+        if not isinstance(axis, str) or not axis:
+            raise ValueError(f"mesh_shape axis names must be strings, got {axis!r}")
+        try:
+            size_i = int(size)
+            ok = size_i >= 1 and size_i == float(size)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            raise ValueError(f"mesh_shape[{axis!r}] must be a positive int, got {size!r}")
+        shape[axis] = size_i
+    return make_mesh(shape)
+
+
+def validate_batch_axis(mesh: Optional[Mesh], n: int, what: str,
+                        axis: str = "data") -> None:
+    """Reject meshes missing the batch axis and batch sizes the mesh
+    cannot shard evenly (either would otherwise surface as a cryptic
+    sharding error deep inside XLA)."""
+    if mesh is None:
+        return
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh_shape must include a {axis!r} axis (got axes "
+            f"{list(mesh.axis_names)}): the trainers shard the env "
+            f"batch over it"
+        )
+    k = mesh.shape[axis]
+    if n % k != 0:
+        raise ValueError(
+            f"{what}={n} is not divisible by mesh axis {axis!r} size {k}; "
+            f"choose {what} as a multiple of {k}"
+        )
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

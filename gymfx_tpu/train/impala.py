@@ -337,7 +337,11 @@ class ImpalaTrainer:
 def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     env = Environment(config)
     icfg = impala_config_from(config)
-    trainer = ImpalaTrainer(env, icfg)
+    from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
+
+    mesh = mesh_from_config(config)
+    validate_batch_axis(mesh, icfg.n_envs, "num_envs")
+    trainer = ImpalaTrainer(env, icfg, mesh=mesh)
     total = int(config.get("train_total_steps", 1_000_000))
     state, train_metrics = trainer.train(total, seed=int(config.get("seed", 0) or 0))
 
@@ -347,6 +351,8 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     eval_shim = _EvalShim(trainer)
     summary = ppo_mod.evaluate(eval_shim, state.learner_params)
     summary["train_metrics"] = train_metrics
+    if mesh is not None:
+        summary["mesh_shape"] = dict(mesh.shape)
 
     ckpt_dir = config.get("checkpoint_dir")
     if ckpt_dir:

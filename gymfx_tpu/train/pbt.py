@@ -62,9 +62,20 @@ class PBTTrainer:
         pcfg: PPOConfig = None,
         pbt: PBTConfig = PBTConfig(),
         core=None,
+        mesh=None,
     ):
         self.trainer = core if core is not None else _PBTTrainerCore(env, pcfg)
         self.pbt = pbt
+        # Pod-scale placement: the POPULATION axis shards over the mesh
+        # 'data' axis (members are embarrassingly parallel between
+        # exploit/explore syncs), so P members train on P/devices chips
+        # each — distinct from the single-trainer mesh, which shards the
+        # env batch of ONE member.
+        self.mesh = mesh
+        if mesh is not None:
+            from gymfx_tpu.parallel import validate_batch_axis
+
+            validate_batch_axis(mesh, pbt.population, "pbt_population")
         self._vstep = jax.jit(jax.vmap(self.trainer._train_step_impl), donate_argnums=0)
         self._vinit = jax.jit(jax.vmap(self.trainer.init_state_from_key))
 
@@ -80,6 +91,14 @@ class PBTTrainer:
             )
         )
         states = self._set_lrs(states, jnp.asarray(lrs, jnp.float32))
+        if self.mesh is not None:
+            from gymfx_tpu.parallel import batch_sharding
+
+            pop = batch_sharding(self.mesh)
+            states = jax.tree.map(
+                lambda x: jax.device_put(x, pop) if hasattr(x, "shape") else x,
+                states,
+            )
         fitness = np.zeros(self.pbt.population)
         return states, fitness
 
@@ -179,7 +198,8 @@ class _PBTPortfolioCore:
         return Core(env, pcfg)
 
 
-def make_portfolio_pbt(config: Dict[str, Any], pbt: PBTConfig) -> "PBTTrainer":
+def make_portfolio_pbt(config: Dict[str, Any], pbt: PBTConfig,
+                       mesh=None) -> "PBTTrainer":
     from gymfx_tpu.core.portfolio import PortfolioEnvironment
     from gymfx_tpu.train.portfolio_ppo import PortfolioPPOConfig
 
@@ -192,10 +212,14 @@ def make_portfolio_pbt(config: Dict[str, Any], pbt: PBTConfig) -> "PBTTrainer":
         lr=float(config.get("learning_rate", 3e-4)),
         policy=str(config.get("policy") or "mlp"),
     )
-    return PBTTrainer(env, None, pbt, core=_PBTPortfolioCore(env, pcfg))
+    return PBTTrainer(env, None, pbt, core=_PBTPortfolioCore(env, pcfg),
+                      mesh=mesh)
 
 
 def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    from gymfx_tpu.parallel import mesh_from_config
+
+    mesh = mesh_from_config(config)
     if config.get("portfolio_files"):
         pbt = PBTConfig(
             population=int(config.get("pbt_population", 8)),
@@ -206,13 +230,16 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
             perturb=float(config.get("pbt_perturb", 1.25)),
             fitness_decay=float(config.get("pbt_fitness_decay", 0.7)),
         )
-        trainer = make_portfolio_pbt(config, pbt)
+        trainer = make_portfolio_pbt(config, pbt, mesh=mesh)
         result = trainer.train(
             int(config.get("train_total_steps", 1_000_000)),
             seed=int(config.get("seed", 0) or 0),
         )
         result.pop("best_params", None)
-        return {"mode": "training", "trainer": "pbt_portfolio", "pbt": result}
+        out = {"mode": "training", "trainer": "pbt_portfolio", "pbt": result}
+        if mesh is not None:
+            out["mesh_shape"] = dict(mesh.shape)
+        return out
 
     env = Environment(config)
     pcfg = ppo_config_from(config)
@@ -225,7 +252,7 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         perturb=float(config.get("pbt_perturb", 1.25)),
         fitness_decay=float(config.get("pbt_fitness_decay", 0.7)),
     )
-    trainer = PBTTrainer(env, pcfg, pbt)
+    trainer = PBTTrainer(env, pcfg, pbt, mesh=mesh)
     result = trainer.train(
         int(config.get("train_total_steps", 1_000_000)),
         seed=int(config.get("seed", 0) or 0),
@@ -236,6 +263,8 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
     summary = ppo_mod.evaluate(trainer.trainer, best_params)
     summary["pbt"] = result
+    if mesh is not None:
+        summary["mesh_shape"] = dict(mesh.shape)
 
     ckpt_dir = config.get("checkpoint_dir")
     if ckpt_dir:

@@ -152,9 +152,11 @@ def _encode_tokens(obs: Dict[str, Any], window: int):
 
 
 class PortfolioPPOTrainer:
-    def __init__(self, env: P.PortfolioEnvironment, pcfg: PortfolioPPOConfig):
+    def __init__(self, env: P.PortfolioEnvironment, pcfg: PortfolioPPOConfig,
+                 mesh: Optional[Any] = None):
         self.env = env
         self.pcfg = pcfg
+        self.mesh = mesh
         n_pairs = env.cfg.n_pairs
         if pcfg.policy == "transformer":
             self.policy = PortfolioTransformerPolicy(n_pairs=n_pairs)
@@ -192,7 +194,20 @@ class PortfolioPPOTrainer:
         )
 
     def init_state(self, seed: int = 0) -> PortfolioTrainState:
-        return self.init_state_from_key(jax.random.PRNGKey(seed))
+        state = self.init_state_from_key(jax.random.PRNGKey(seed))
+        if self.mesh is not None:
+            from gymfx_tpu.train.common import shard_train_state
+
+            state = state._replace(
+                **shard_train_state(
+                    self.mesh,
+                    params={"params": state.params},
+                    replicated={"opt_state": state.opt_state, "rng": state.rng},
+                    batched={"env_states": state.env_states,
+                             "obs_vec": state.obs_vec},
+                )
+            )
+        return state
 
     def init_state_from_key(self, rng) -> PortfolioTrainState:
         rng, k = jax.random.split(rng)
@@ -364,13 +379,19 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         lr=float(config.get("learning_rate", 3e-4)),
         policy=str(config.get("policy") or "mlp"),
     )
-    trainer = PortfolioPPOTrainer(env, pcfg)
+    from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
+
+    mesh = mesh_from_config(config)
+    validate_batch_axis(mesh, pcfg.n_envs, "num_envs")
+    trainer = PortfolioPPOTrainer(env, pcfg, mesh=mesh)
     state, metrics = trainer.train(
         int(config.get("train_total_steps", 1_000_000)),
         seed=int(config.get("seed", 0) or 0),
     )
     summary = {"mode": "training", "trainer": "portfolio_ppo",
                "pairs": env.pairs, "train_metrics": metrics}
+    if mesh is not None:
+        summary["mesh_shape"] = dict(mesh.shape)
     ckpt_dir = config.get("checkpoint_dir")
     if ckpt_dir:
         from gymfx_tpu.train.checkpoint import save_checkpoint

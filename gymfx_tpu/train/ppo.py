@@ -528,7 +528,11 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     return a summary merging training metrics and greedy-eval metrics."""
     env = Environment(config)
     pcfg = ppo_config_from(config)
-    trainer = PPOTrainer(env, pcfg)
+    from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
+
+    mesh = mesh_from_config(config)
+    validate_batch_axis(mesh, pcfg.n_envs, "num_envs")
+    trainer = PPOTrainer(env, pcfg, mesh=mesh)
     total = int(config.get("train_total_steps", 1_000_000))
     resume_params = None
     resume_step = 0
@@ -555,6 +559,8 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
     summary = evaluate(trainer, state.params)
     summary["train_metrics"] = train_metrics
+    if mesh is not None:
+        summary["mesh_shape"] = dict(mesh.shape)
 
     ckpt_dir = config.get("checkpoint_dir")
     if ckpt_dir:
