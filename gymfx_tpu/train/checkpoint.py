@@ -1,29 +1,132 @@
 """Checkpoint/resume via orbax — new capability (the reference has no
 training checkpointing; closest mechanisms are action replay and config
-save/restore, SURVEY.md §5.4)."""
+save/restore, SURVEY.md §5.4).
+
+Format ("composite"): two orbax items per step —
+  state   the trainer's FULL train state (params + optimizer state +
+          env batch + RNG), so a resumed run continues the exact
+          trajectory an uninterrupted run would have produced;
+  params  the policy params alone, so evaluation restores them without
+          paying the I/O of the whole train state.
+``metadata.json`` records the policy architecture and the state format.
+Legacy single-item checkpoints (round-2 "params" format, PBT
+best-member saves) load through the same functions.
+
+Zero-size leaves (e.g. a (N, W, 0) feature window when no feature
+columns are configured) cannot be stored by orbax; they are masked with
+a placeholder at save and rebuilt at load — from the template when one
+is given, else from the ``empty_leaves_<step>.json`` sidecar.
+"""
 from __future__ import annotations
 
 import json
+import math
+import warnings
 from pathlib import Path
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
+import jax
+import numpy as np
 import orbax.checkpoint as ocp
+
+
+def _is_empty(x: Any) -> bool:
+    return hasattr(x, "shape") and math.prod(x.shape) == 0
+
+
+def _mask_empty(tree: Any) -> Any:
+    return jax.tree.map(
+        lambda x: np.zeros((1,), np.float32) if _is_empty(x) else x, tree
+    )
+
+
+def _unmask_empty(template: Any, restored: Any) -> Any:
+    return jax.tree.map(
+        lambda t, r: np.zeros(t.shape, t.dtype) if _is_empty(t) else r,
+        template,
+        restored,
+    )
+
+
+def _empty_record(tree: Any, prefix: Tuple = ()) -> List[Dict[str, Any]]:
+    """Paths (as orbax's raw-restored dict/list structure addresses
+    them: NamedTuples become dicts keyed by field) + shape/dtype of
+    every zero-size leaf."""
+    if isinstance(tree, dict):
+        items = tree.items()
+    elif hasattr(tree, "_asdict"):  # NamedTuple
+        items = tree._asdict().items()
+    elif isinstance(tree, (list, tuple)):
+        items = enumerate(tree)
+    else:
+        if _is_empty(tree):
+            return [{
+                "path": list(prefix),
+                "shape": list(tree.shape),
+                "dtype": str(np.dtype(tree.dtype)),
+            }]
+        return []
+    out: List[Dict[str, Any]] = []
+    for k, v in items:
+        out.extend(_empty_record(v, prefix + (k,)))
+    return out
+
+
+def _apply_empty_record(tree: Any, records: List[Dict[str, Any]]) -> Any:
+    for rec in records:
+        node = tree
+        for k in rec["path"][:-1]:
+            node = node[k]
+        node[rec["path"][-1]] = np.zeros(
+            tuple(rec["shape"]), np.dtype(rec["dtype"])
+        )
+    return tree
 
 
 def save_checkpoint(
     directory: str,
-    params: Any,
+    tree: Any,
     step: int = 0,
     metadata: Optional[Dict[str, Any]] = None,
+    params: Optional[Any] = None,
 ) -> str:
-    """Save params (+ a metadata.json describing e.g. which policy
-    architecture produced them, so evaluation can rebuild the right
-    template without the user re-passing --policy)."""
+    """Save a checkpoint at ``step``.
+
+    With ``params`` given, ``tree`` is a full train-state dict and the
+    two are stored as separate items (composite format); without, a
+    bare pytree (params-only saves).  Orbax silently skips a step that
+    already exists — in that case the metadata is left untouched too,
+    so it can never describe a tree that was not actually stored.
+    """
     path = Path(directory).resolve()
     path.mkdir(parents=True, exist_ok=True)
     with ocp.CheckpointManager(path) as mngr:
-        mngr.save(int(step), args=ocp.args.StandardSave(params))
+        if int(step) in set(mngr.all_steps()):
+            warnings.warn(
+                f"checkpoint step {step} already exists under {path}; "
+                "orbax skips the save — advance the step to persist",
+                stacklevel=2,
+            )
+            return str(path)
+        if params is not None:
+            metadata = {**(metadata or {}), "state_format": "composite"}
+            empties = {"state": _empty_record(tree),
+                       "params": _empty_record(params)}
+            mngr.save(
+                int(step),
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(_mask_empty(tree)),
+                    params=ocp.args.StandardSave(_mask_empty(params)),
+                ),
+            )
+        else:
+            empties = {"default": _empty_record(tree)}
+            mngr.save(int(step), args=ocp.args.StandardSave(_mask_empty(tree)))
         mngr.wait_until_finished()
+    if any(empties.values()):
+        (path / f"empty_leaves_{int(step)}.json").write_text(
+            json.dumps(empties)
+        )
     if metadata is not None:
         (path / "metadata.json").write_text(json.dumps(metadata, indent=2))
     return str(path)
@@ -37,14 +140,107 @@ def read_metadata(directory: str) -> Dict[str, Any]:
 
 
 def load_checkpoint(directory: str, template: Optional[Any] = None) -> Tuple[Any, int]:
-    """Load the latest checkpoint; returns (params, step)."""
+    """Load the latest checkpoint's main tree (the full train state for
+    composite checkpoints, the bare tree otherwise); returns (tree, step).
+
+    With ``template`` (a pytree of arrays or ShapeDtypeStructs) the
+    restore is validated against it; without, the raw stored tree comes
+    back (NamedTuples as plain dicts — fine for params consumers).
+    """
+    composite = read_metadata(directory).get("state_format") == "composite"
+    return _restore_item(directory, "state" if composite else None, template)
+
+
+def load_params(directory: str, template: Optional[Any] = None) -> Tuple[Any, int]:
+    """Policy params from a checkpoint of any format, restoring ONLY the
+    params item when the format allows (composite), so evaluation never
+    pays the full-train-state I/O."""
+    fmt = read_metadata(directory).get("state_format")
+    if fmt == "composite":
+        return _restore_item(directory, "params", template)
+    tree, step = _restore_item(
+        directory, None, template if fmt != "train_state" else None
+    )
+    if fmt == "train_state" or (
+        fmt is None and isinstance(tree, dict) and "opt_state" in tree
+    ):
+        # transitional full-state single-item format: pick the params
+        # subtree (PPO stores "params"; IMPALA "learner_params")
+        for key in ("params", "learner_params"):
+            if key in tree:
+                return tree[key], step
+        raise KeyError(
+            f"train_state checkpoint in {directory} has no params entry "
+            f"(keys: {sorted(tree)})"
+        )
+    return tree, step
+
+
+def load_train_state(directory: str, trainer: Any, state_cls: Any):
+    """Resume helper shared by the trainers: returns
+    ``(initial_state, initial_params, step)`` — a full train state when
+    the checkpoint carries one, else params for a warm start.
+
+    ``trainer`` must expose ``init_state_from_key`` (the unsharded
+    template source); ``state_cls`` is its train-state NamedTuple.
+    """
+    if read_metadata(directory).get("state_format") in ("composite", "train_state"):
+        template = jax.eval_shape(
+            trainer.init_state_from_key, jax.random.PRNGKey(0)
+        )._asdict()
+        restored, step = load_checkpoint(directory, template=template)
+        return state_cls(**restored), None, step
+    # params-only checkpoint (round-2 format / PBT best member)
+    pfield = "params" if "params" in state_cls._fields else "learner_params"
+    ptpl = jax.eval_shape(
+        lambda k: getattr(trainer.init_state_from_key(k), pfield),
+        jax.random.PRNGKey(0),
+    )
+    params, step = load_params(directory, template=ptpl)
+    return None, params, step
+
+
+def resume_from_config(config: Dict[str, Any], trainer: Any, state_cls: Any):
+    """The trainers' shared --resume_training entry: returns
+    ``(initial_state, initial_params, resume_step)``, all falsy when the
+    config does not ask for a resume or the directory is empty."""
+    ckpt_dir = config.get("checkpoint_dir")
+    if not (ckpt_dir and config.get("resume_training")):
+        return None, None, 0
+    try:
+        return load_train_state(str(ckpt_dir), trainer, state_cls)
+    except FileNotFoundError:
+        return None, None, 0  # cold start, empty dir
+
+
+def _restore_item(
+    directory: str, item: Optional[str], template: Optional[Any]
+) -> Tuple[Any, int]:
     path = Path(directory).resolve()
     with ocp.CheckpointManager(path) as mngr:
         step = mngr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found under {path}")
-        if template is not None:
-            params = mngr.restore(step, args=ocp.args.StandardRestore(template))
+        if item is not None:
+            args = (
+                ocp.args.StandardRestore(_mask_empty(template))
+                if template is not None
+                else ocp.args.StandardRestore()
+            )
+            restored = mngr.restore(
+                step, args=ocp.args.Composite(**{item: args})
+            )[item]
+        elif template is not None:
+            restored = mngr.restore(
+                step, args=ocp.args.StandardRestore(_mask_empty(template))
+            )
         else:
-            params = mngr.restore(step)
-    return params, int(step)
+            restored = mngr.restore(step)
+    if template is not None:
+        restored = _unmask_empty(template, restored)
+    else:
+        sidecar = path / f"empty_leaves_{int(step)}.json"
+        if sidecar.exists():
+            records = json.loads(sidecar.read_text()).get(item or "default", [])
+            restored = _apply_empty_record(restored, records)
+    return restored, int(step)

@@ -127,7 +127,14 @@ class ImpalaTrainer:
 
     # ------------------------------------------------------------------
     def init_state(self, seed: int = 0) -> ImpalaState:
-        rng = jax.random.PRNGKey(seed)
+        state = self.init_state_from_key(jax.random.PRNGKey(seed))
+        if self.mesh is not None:
+            state = self._shard_state(state)
+        return state
+
+    def init_state_from_key(self, rng) -> ImpalaState:
+        """Key-based, unsharded init (traceable; also the resume-template
+        shape source)."""
         rng, k = jax.random.split(rng)
         carry0 = self.policy.initial_carry(())
         if self.icfg.policy == "lstm":
@@ -148,22 +155,23 @@ class ImpalaTrainer:
             rng=rng,
             updates_since_sync=jnp.zeros((), jnp.int32),
         )
-        if self.mesh is not None:
-            from gymfx_tpu.train.common import shard_train_state
-
-            state = state._replace(
-                **shard_train_state(
-                    self.mesh,
-                    params={"learner_params": state.learner_params,
-                            "actor_params": state.actor_params},
-                    replicated={"opt_state": state.opt_state, "rng": state.rng,
-                                "updates_since_sync": state.updates_since_sync},
-                    batched={"env_states": state.env_states,
-                             "obs_vec": state.obs_vec,
-                             "policy_carry": state.policy_carry},
-                )
-            )
         return state
+
+    def _shard_state(self, state: ImpalaState) -> ImpalaState:
+        from gymfx_tpu.train.common import shard_train_state
+
+        return state._replace(
+            **shard_train_state(
+                self.mesh,
+                params={"learner_params": state.learner_params,
+                        "actor_params": state.actor_params},
+                replicated={"opt_state": state.opt_state, "rng": state.rng,
+                            "updates_since_sync": state.updates_since_sync},
+                batched={"env_states": state.env_states,
+                         "obs_vec": state.obs_vec,
+                         "policy_carry": state.policy_carry},
+            )
+        )
 
     # ------------------------------------------------------------------
     def _rollout(self, actor_params, env_states, obs_vec, pcarry, rng):
@@ -314,8 +322,21 @@ class ImpalaTrainer:
     def train_step(self, state: ImpalaState):
         return self._train_step(state)
 
-    def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0):
-        state = self.init_state(seed)
+    def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0,
+              initial_state: Optional[ImpalaState] = None,
+              initial_params=None):
+        if initial_state is not None:
+            state = initial_state
+            if self.mesh is not None:
+                state = self._shard_state(state)
+        else:
+            state = self.init_state(seed)
+        if initial_params is not None:
+            # params-only warm start: both copies (learner + stale actor)
+            state = state._replace(
+                learner_params=initial_params,
+                actor_params=jax.tree.map(jnp.copy, initial_params),
+            )
         per_iter = self.icfg.n_envs * self.icfg.unroll
         iters = max(1, int(total_env_steps) // per_iter)
         t0 = time.perf_counter()
@@ -343,7 +364,15 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     validate_batch_axis(mesh, icfg.n_envs, "num_envs")
     trainer = ImpalaTrainer(env, icfg, mesh=mesh)
     total = int(config.get("train_total_steps", 1_000_000))
-    state, train_metrics = trainer.train(total, seed=int(config.get("seed", 0) or 0))
+    from gymfx_tpu.train.checkpoint import resume_from_config
+
+    resume_state, resume_params, resume_step = resume_from_config(
+        config, trainer, ImpalaState
+    )
+    state, train_metrics = trainer.train(
+        total, seed=int(config.get("seed", 0) or 0),
+        initial_state=resume_state, initial_params=resume_params,
+    )
 
     # greedy eval through the shared evaluate() machinery
     from gymfx_tpu.train import ppo as ppo_mod
@@ -359,10 +388,11 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         from gymfx_tpu.train.checkpoint import save_checkpoint
 
         save_checkpoint(
-            ckpt_dir, state.learner_params,
-            step=train_metrics["total_env_steps"],
+            ckpt_dir, state._asdict(),
+            step=resume_step + train_metrics["total_env_steps"],
             metadata={"policy": icfg.policy,
                       "policy_kwargs": dict(icfg.policy_kwargs)},
+            params=state.learner_params,
         )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

@@ -91,16 +91,21 @@ class PBTTrainer:
             )
         )
         states = self._set_lrs(states, jnp.asarray(lrs, jnp.float32))
-        if self.mesh is not None:
-            from gymfx_tpu.parallel import batch_sharding
-
-            pop = batch_sharding(self.mesh)
-            states = jax.tree.map(
-                lambda x: jax.device_put(x, pop) if hasattr(x, "shape") else x,
-                states,
-            )
+        states = self._place(states)
         fitness = np.zeros(self.pbt.population)
         return states, fitness
+
+    def _place(self, states):
+        """Shard the population axis over the mesh (no-op without one)."""
+        if self.mesh is None:
+            return states
+        from gymfx_tpu.parallel import batch_sharding
+
+        pop = batch_sharding(self.mesh)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, pop) if hasattr(x, "shape") else x,
+            states,
+        )
 
     def _set_lrs(self, states, lrs):
         opt_state = states.opt_state
@@ -134,6 +139,9 @@ class PBTTrainer:
             factor = self.pbt.perturb if rng.random() < 0.5 else 1.0 / self.pbt.perturb
             lrs[b] = float(np.clip(lrs[b] * factor, self.pbt.lr_min, self.pbt.lr_max))
         states = self._set_lrs(states, jnp.asarray(lrs, jnp.float32))
+        # the donor gather returns replicated arrays; re-shard the
+        # population axis or the rest of training runs unsharded
+        states = self._place(states)
         fitness[list(src_for)] = fitness[[src_for[b] for b in src_for]]
         return states, fitness, sorted(src_for)
 
@@ -273,7 +281,8 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         save_checkpoint(
             ckpt_dir, best_params, step=result["total_env_steps"],
             metadata={"policy": pcfg.policy,
-                      "policy_kwargs": dict(pcfg.policy_kwargs)},
+                      "policy_kwargs": dict(pcfg.policy_kwargs),
+                      "state_format": "params"},
         )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

@@ -399,7 +399,7 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         save_checkpoint(
             ckpt_dir, state.params, step=metrics["total_env_steps"],
             metadata={"policy": f"portfolio_{pcfg.policy}",
-                      "pairs": env.pairs},
+                      "pairs": env.pairs, "state_format": "params"},
         )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

@@ -410,11 +410,17 @@ class PPOTrainer:
         return self._train_step(state)
 
     def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0,
-              initial_params=None):
+              initial_params=None, initial_state: Optional[TrainState] = None):
         """Run PPO for ~total_env_steps; log metrics every ``log_every``
-        iterations when > 0.  ``initial_params`` warm-starts the policy
-        (checkpoint resume)."""
-        state = self.init_state(seed)
+        iterations when > 0.  ``initial_state`` continues a checkpointed
+        run exactly (full TrainState: params + opt_state + env batch +
+        RNG); ``initial_params`` is a params-only warm start."""
+        if initial_state is not None:
+            state = initial_state
+            if self.mesh is not None:
+                state = self._shard_state(state)
+        else:
+            state = self.init_state(seed)
         if initial_params is not None:
             state = state._replace(params=initial_params)
         steps_per_iter = self.pcfg.n_envs * self.pcfg.horizon
@@ -504,7 +510,7 @@ def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     ckpt_dir = config.get("checkpoint_dir")
     if not ckpt_dir:
         raise ValueError("driver_mode=policy requires checkpoint_dir")
-    from gymfx_tpu.train.checkpoint import load_checkpoint, read_metadata
+    from gymfx_tpu.train.checkpoint import load_params, read_metadata
 
     # the checkpoint records which policy architecture produced it; honor
     # that unless the user explicitly overrides --policy
@@ -516,8 +522,12 @@ def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
     env = Environment(config)
     trainer = PPOTrainer(env, ppo_config_from(config))
-    template = trainer.init_state(0).params
-    params, step = load_checkpoint(str(ckpt_dir), template=template)
+    # template-validated restore: an architecture mismatch fails loudly
+    # at load time, not as an opaque shape error inside the episode scan
+    template = jax.eval_shape(
+        lambda k: trainer.init_state_from_key(k).params, jax.random.PRNGKey(0)
+    )
+    params, step = load_params(str(ckpt_dir), template=template)
     summary = evaluate(trainer, params, steps=config.get("steps"))
     summary["checkpoint_step"] = step
     return summary
@@ -534,27 +544,16 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     validate_batch_axis(mesh, pcfg.n_envs, "num_envs")
     trainer = PPOTrainer(env, pcfg, mesh=mesh)
     total = int(config.get("train_total_steps", 1_000_000))
-    resume_params = None
-    resume_step = 0
-    ckpt_dir = config.get("checkpoint_dir")
-    if ckpt_dir and config.get("resume_training"):
-        from gymfx_tpu.train.checkpoint import load_checkpoint
+    from gymfx_tpu.train.checkpoint import resume_from_config
 
-        try:
-            # shape/dtype template only — building a full TrainState
-            # would allocate the whole env batch just to restore params
-            template = jax.eval_shape(
-                lambda k: trainer.init_state_from_key(k).params,
-                jax.random.PRNGKey(0),
-            )
-            resume_params, resume_step = load_checkpoint(
-                str(ckpt_dir), template=template
-            )
-        except FileNotFoundError:
-            resume_params, resume_step = None, 0  # cold start, empty dir
+    # full-state checkpoints continue the exact trajectory (opt moments,
+    # env batch, RNG); params-only ones warm-start
+    resume_state, resume_params, resume_step = resume_from_config(
+        config, trainer, TrainState
+    )
     state, train_metrics = trainer.train(
         total, seed=int(config.get("seed", 0) or 0),
-        initial_params=resume_params,
+        initial_params=resume_params, initial_state=resume_state,
     )
 
     summary = evaluate(trainer, state.params)
@@ -569,10 +568,11 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         # cumulative step count: orbax silently skips saving a step that
         # already exists, so a resumed run must advance past the loaded step
         save_checkpoint(
-            ckpt_dir, state.params,
+            ckpt_dir, state._asdict(),
             step=resume_step + train_metrics["total_env_steps"],
             metadata={"policy": pcfg.policy,
                       "policy_kwargs": dict(pcfg.policy_kwargs)},
+            params=state.params,
         )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

@@ -32,6 +32,39 @@ def test_population_trains_with_distinct_learning_rates():
     assert np.isfinite(np.asarray(metrics["loss"])).all()
 
 
+def test_population_sharding_survives_exploit_explore():
+    """Pod-scale PBT: the population axis must stay sharded over the
+    mesh AFTER exploit/explore (the donor gather replicates; r3 review
+    finding — without re-placement the rest of training runs unsharded)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from gymfx_tpu.core.runtime import Environment as _E  # noqa: F401
+    from gymfx_tpu.parallel import make_mesh
+    from gymfx_tpu.train.pbt import PBTConfig, PBTTrainer
+    from gymfx_tpu.train.ppo import ppo_config_from
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=4, ppo_horizon=8,
+                  ppo_epochs=1, ppo_minibatches=2,
+                  policy_kwargs={"hidden": [16, 16]})
+    env = Environment(config, dataset=MarketDataset(uptrend_df(80), config))
+    pbt = PBTTrainer(env, ppo_config_from(config),
+                     PBTConfig(population=8, interval=2),
+                     mesh=make_mesh({"data": 8}))
+    states, fitness = pbt.init_population(0)
+    assert states.obs_vec.sharding.spec == P("data")
+    fitness = np.arange(8, dtype=np.float64)
+    states, fitness, replaced = pbt._exploit_explore(
+        states, fitness, np.random.default_rng(0)
+    )
+    assert replaced  # someone was replaced
+    # params and env batch are sharded again after the donor copy
+    leaf = jax.tree.leaves(states.params)[0]
+    assert leaf.sharding.spec == P("data"), leaf.sharding
+    assert states.obs_vec.sharding.spec == P("data")
+
+
 def test_exploit_explore_copies_top_params_to_bottom():
     import jax
 

@@ -90,6 +90,126 @@ def test_checkpoint_roundtrip(tmp_path):
     assert step == 7
     for a, b in zip(jax.tree.leaves(s.params), jax.tree.leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # a bare-params checkpoint resumes as a WARM START, not a cold start
+    from gymfx_tpu.train.checkpoint import load_train_state
+    from gymfx_tpu.train.ppo import TrainState
+
+    state, warm, step = load_train_state(str(tmp_path / "ckpt"), tr, TrainState)
+    assert state is None and warm is not None and step == 7
+
+
+def test_full_state_resume_continues_exact_trajectory(tmp_path):
+    """True resume (VERDICT r2 weak #2): a run restored from the full
+    TrainState checkpoint must produce the SAME trajectory as the
+    uninterrupted run — optimizer moments, env batch and RNG included."""
+    import jax
+
+    from gymfx_tpu.train.checkpoint import (
+        load_params,
+        load_train_state,
+        save_checkpoint,
+    )
+    from gymfx_tpu.train.ppo import TrainState
+
+    tr = _trainer(num_envs=4, ppo_horizon=8)
+    s = tr.init_state(0)
+    for _ in range(3):
+        s, _ = tr.train_step(s)
+    save_checkpoint(str(tmp_path / "ck"), s._asdict(), step=3,
+                    params=s.params)
+
+    s_res, warm_params, step = load_train_state(str(tmp_path / "ck"), tr, TrainState)
+    assert step == 3 and warm_params is None and s_res is not None
+    # the params item restores standalone (evaluation path)
+    p_only, _ = load_params(str(tmp_path / "ck"))
+    for a, b in zip(jax.tree.leaves(s.params), jax.tree.leaves(p_only)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # uninterrupted continuation vs resumed continuation
+    s_cont = s
+    for _ in range(3):
+        s_cont, m_cont = tr.train_step(s_cont)
+        s_res, m_res = tr.train_step(s_res)
+    for a, b in zip(jax.tree.leaves(s_cont.params), jax.tree.leaves(s_res.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # optimizer state continued too (Adam moments restart would diverge)
+    for a, b in zip(
+        jax.tree.leaves(s_cont.opt_state), jax.tree.leaves(s_res.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_templateless_restore_rebuilds_empty_leaves(tmp_path):
+    """Raw (template-less) restore must return the true zero-size
+    leaves, not the (1,) placeholders the save masked them with."""
+    from gymfx_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+
+    tr = _trainer(num_envs=4, ppo_horizon=8)
+    s = tr.init_state(0)
+    fw = np.asarray(s.env_states.feat_window)
+    assert fw.size == 0  # no feature columns configured -> empty leaf
+    save_checkpoint(str(tmp_path / "ck"), s._asdict(), step=1, params=s.params)
+    raw, _ = load_checkpoint(str(tmp_path / "ck"))  # no template
+    assert tuple(raw["env_states"]["feat_window"].shape) == tuple(fw.shape)
+
+
+def test_config_resume_matches_uninterrupted_run(tmp_path):
+    """End-to-end: train 2x128 steps with --resume_training == one
+    uninterrupted 256-step run, compared on the saved final params."""
+    import jax
+
+    from gymfx_tpu.app.main import main
+    from gymfx_tpu.train.checkpoint import load_checkpoint
+
+    base = ["--mode", "training", "--input_data_file",
+            "examples/data/eurusd_uptrend.csv", "--num_envs", "4",
+            "--ppo_horizon", "16", "--window_size", "8", "--quiet_mode"]
+    ck_a, ck_b = tmp_path / "a", tmp_path / "b"
+    main(base + ["--train_total_steps", "128", "--checkpoint_dir", str(ck_a),
+                 "--results_file", str(tmp_path / "r1.json")])
+    main(base + ["--train_total_steps", "128", "--checkpoint_dir", str(ck_a),
+                 "--resume_training", "true",
+                 "--results_file", str(tmp_path / "r2.json")])
+    main(base + ["--train_total_steps", "256", "--checkpoint_dir", str(ck_b),
+                 "--results_file", str(tmp_path / "r3.json")])
+    tree_a, step_a = load_checkpoint(str(ck_a))
+    tree_b, step_b = load_checkpoint(str(ck_b))
+    assert step_a == step_b == 256
+    for a, b in zip(jax.tree.leaves(tree_a["params"]), jax.tree.leaves(tree_b["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_impala_full_state_resume_is_exact(tmp_path):
+    import jax
+
+    from gymfx_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+    from gymfx_tpu.train.impala import (
+        ImpalaState,
+        ImpalaTrainer,
+        impala_config_from,
+    )
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=4, impala_unroll=8)
+    env = Environment(config, dataset=MarketDataset(uptrend_df(60), config))
+    tr = ImpalaTrainer(env, impala_config_from(config))
+    s = tr.init_state(0)
+    for _ in range(2):
+        s, _ = tr.train_step(s)
+    save_checkpoint(str(tmp_path / "ck"), s._asdict(), step=2,
+                    params=s.learner_params)
+    from gymfx_tpu.train.checkpoint import load_train_state
+
+    s_res, _warm, _step = load_train_state(str(tmp_path / "ck"), tr, ImpalaState)
+    s_cont = s
+    for _ in range(2):
+        s_cont, _ = tr.train_step(s_cont)
+        s_res, _ = tr.train_step(s_res)
+    for a, b in zip(
+        jax.tree.leaves(s_cont.learner_params),
+        jax.tree.leaves(s_res.learner_params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_evaluate_produces_metrics_summary():
