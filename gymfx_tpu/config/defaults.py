@@ -64,6 +64,10 @@ DEFAULT_VALUES = {
     "mesh_shape": None,       # e.g. {"data": 4, "model": 2}; None = single device
     "train_total_steps": 1_000_000,
     "checkpoint_dir": None,
+    # out-of-sample evaluation: hold out the LAST fraction of bars
+    # (chronological split) or evaluate on a separate file
+    "eval_split": None,
+    "eval_data_file": None,
     # policy: unset by default — PPO defaults to "mlp", IMPALA to "lstm";
     # pass --policy mlp|lstm|transformer|transformer_ring|
     # transformer_ulysses to override.

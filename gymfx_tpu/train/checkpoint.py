@@ -158,21 +158,25 @@ def load_params(directory: str, template: Optional[Any] = None) -> Tuple[Any, in
     fmt = read_metadata(directory).get("state_format")
     if fmt == "composite":
         return _restore_item(directory, "params", template)
-    tree, step = _restore_item(
-        directory, None, template if fmt != "train_state" else None
-    )
-    if fmt == "train_state" or (
-        fmt is None and isinstance(tree, dict) and "opt_state" in tree
-    ):
-        # transitional full-state single-item format: pick the params
-        # subtree (PPO stores "params"; IMPALA "learner_params")
+    if fmt == "params":
+        return _restore_item(directory, None, template)
+    # legacy/unknown format: restore raw FIRST (a params template would
+    # mismatch a full-state tree before the subtree pick could run),
+    # then pick the params subtree if the tree is a full train state
+    # (PPO stores "params"; IMPALA "learner_params")
+    tree, step = _restore_item(directory, None, None)
+    if isinstance(tree, dict) and "opt_state" in tree:
         for key in ("params", "learner_params"):
             if key in tree:
-                return tree[key], step
-        raise KeyError(
-            f"train_state checkpoint in {directory} has no params entry "
-            f"(keys: {sorted(tree)})"
-        )
+                tree = tree[key]
+                break
+        else:
+            raise KeyError(
+                f"train_state checkpoint in {directory} has no params "
+                f"entry (keys: {sorted(tree)})"
+            )
+    if template is not None:
+        tree = _validate_like(template, tree, directory)
     return tree, step
 
 
@@ -211,6 +215,31 @@ def resume_from_config(config: Dict[str, Any], trainer: Any, state_cls: Any):
         return load_train_state(str(ckpt_dir), trainer, state_cls)
     except FileNotFoundError:
         return None, None, 0  # cold start, empty dir
+
+
+def _validate_like(template: Any, tree: Any, directory: str) -> Any:
+    """Shape/structure check of a raw-restored tree against the caller's
+    template (a clear load-time error instead of an opaque one later);
+    rebuilds masked empty leaves along the way."""
+    try:
+        return jax.tree.map(
+            lambda t, r: _check_leaf(t, r, directory), template, tree
+        )
+    except ValueError as exc:
+        raise ValueError(
+            f"checkpoint in {directory} does not match the configured "
+            f"policy architecture: {exc}"
+        ) from None
+
+
+def _check_leaf(t: Any, r: Any, directory: str) -> Any:
+    if _is_empty(t):
+        return np.zeros(t.shape, t.dtype)
+    if tuple(t.shape) != tuple(np.shape(r)):
+        raise ValueError(
+            f"stored leaf shape {tuple(np.shape(r))} != expected {tuple(t.shape)}"
+        )
+    return r
 
 
 def _restore_item(

@@ -1,10 +1,84 @@
 """Shared training helpers."""
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+def build_train_eval_envs(config: Dict[str, Any]) -> Tuple[Any, Optional[Any]]:
+    """(train_env, eval_env-or-None) honoring the out-of-sample keys.
+
+    ``eval_data_file``   evaluate on a separate dataset file;
+    ``eval_split``       hold out the LAST fraction of bars (chronological
+                         split — the only sound one for market series:
+                         a random split would leak future bars into
+                         training).
+    Without either, eval_env is None and evaluation is in-sample (the
+    round-2 behavior, now labeled as such in the summary).
+    """
+    from gymfx_tpu.core.runtime import Environment
+
+    eval_file = config.get("eval_data_file")
+    split = config.get("eval_split")
+    if eval_file and split:
+        raise ValueError("set either eval_data_file or eval_split, not both")
+    if eval_file:
+        eval_config = dict(config)
+        eval_config["input_data_file"] = str(eval_file)
+        return Environment(config), Environment(eval_config)
+    if split:
+        from gymfx_tpu.data.feed import MarketDataset, load_dataframe
+
+        frac = float(split)
+        if not 0.0 < frac < 1.0:
+            raise ValueError(f"eval_split must be in (0, 1), got {split!r}")
+        df = load_dataframe(config)
+        cut = len(df) - int(len(df) * frac)
+        min_bars = int(config.get("window_size", 32)) + 2
+        if cut < min_bars or len(df) - cut < min_bars:
+            raise ValueError(
+                f"eval_split={frac} leaves too few bars (train {cut}, "
+                f"eval {len(df) - cut}; both need >= {min_bars})"
+            )
+        train_env = Environment(
+            config, dataset=MarketDataset(df.iloc[:cut], config)
+        )
+        eval_env = Environment(
+            config, dataset=MarketDataset(df.iloc[cut:], config)
+        )
+        return train_env, eval_env
+    return Environment(config), None
+
+
+def labeled_eval_summary(make_summary, train_env, eval_env) -> Dict[str, Any]:
+    """One definition of the out-of-sample summary shape for every
+    trainer: ``make_summary(env_or_None)`` runs a greedy evaluation on
+    the given env (None = the training env)."""
+    if eval_env is None:
+        summary = make_summary(None)
+        summary["eval_scope"] = "in_sample"
+        return summary
+    summary = make_summary(eval_env)
+    summary["eval_scope"] = "held_out"
+    summary["eval_bars"] = eval_env.n_bars
+    summary["train_bars"] = train_env.n_bars
+    summary["in_sample"] = make_summary(None)
+    return summary
+
+
+def reject_eval_keys(config: Dict[str, Any], trainer_name: str) -> None:
+    """Honor-or-reject: trainers without held-out evaluation machinery
+    must refuse the out-of-sample keys rather than silently reporting
+    in-sample numbers."""
+    for key in ("eval_split", "eval_data_file"):
+        if config.get(key):
+            raise ValueError(
+                f"{key} is not supported by the {trainer_name} trainer "
+                "(no held-out evaluation machinery yet); remove the key "
+                "or use the single-pair trainers"
+            )
 
 
 def masked_reset(done, fresh_tree, cur_tree):

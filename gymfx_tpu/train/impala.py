@@ -356,7 +356,9 @@ class ImpalaTrainer:
 
 
 def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
-    env = Environment(config)
+    from gymfx_tpu.train.common import build_train_eval_envs
+
+    env, eval_env = build_train_eval_envs(config)
     icfg = impala_config_from(config)
     from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
 
@@ -377,8 +379,14 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     # greedy eval through the shared evaluate() machinery
     from gymfx_tpu.train import ppo as ppo_mod
 
-    eval_shim = _EvalShim(trainer)
-    summary = ppo_mod.evaluate(eval_shim, state.learner_params)
+    from gymfx_tpu.train.common import labeled_eval_summary
+
+    summary = labeled_eval_summary(
+        lambda e: ppo_mod.evaluate(
+            _EvalShim(trainer, env=e), state.learner_params
+        ),
+        env, eval_env,
+    )
     summary["train_metrics"] = train_metrics
     if mesh is not None:
         summary["mesh_shape"] = dict(mesh.shape)
@@ -399,10 +407,11 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
 
 class _EvalShim:
-    """Duck-typed adapter exposing the trainer surface evaluate() needs."""
+    """Duck-typed adapter exposing the trainer surface evaluate() needs;
+    ``env`` overrides the episode dataset (held-out evaluation)."""
 
-    def __init__(self, trainer: ImpalaTrainer):
-        self.env = trainer.env
+    def __init__(self, trainer: ImpalaTrainer, env=None):
+        self.env = env if env is not None else trainer.env
         self.policy = trainer.policy
         self._encode = trainer._encode
         self._policy_forward = trainer._forward

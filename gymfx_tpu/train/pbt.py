@@ -229,6 +229,9 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
     mesh = mesh_from_config(config)
     if config.get("portfolio_files"):
+        from gymfx_tpu.train.common import reject_eval_keys
+
+        reject_eval_keys(config, "portfolio PBT")
         pbt = PBTConfig(
             population=int(config.get("pbt_population", 8)),
             interval=int(config.get("pbt_interval", 5)),
@@ -249,7 +252,9 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
             out["mesh_shape"] = dict(mesh.shape)
         return out
 
-    env = Environment(config)
+    from gymfx_tpu.train.common import build_train_eval_envs
+
+    env, eval_env = build_train_eval_envs(config)
     pcfg = ppo_config_from(config)
     pbt = PBTConfig(
         population=int(config.get("pbt_population", 8)),
@@ -269,7 +274,14 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
     from gymfx_tpu.train import ppo as ppo_mod
 
-    summary = ppo_mod.evaluate(trainer.trainer, best_params)
+    from gymfx_tpu.train.common import labeled_eval_summary
+
+    summary = labeled_eval_summary(
+        lambda e: ppo_mod.evaluate(
+            trainer.trainer if e is None else PPOTrainer(e, pcfg), best_params
+        ),
+        env, eval_env,
+    )
     summary["pbt"] = result
     if mesh is not None:
         summary["mesh_shape"] = dict(mesh.shape)

@@ -370,6 +370,9 @@ class PortfolioPPOTrainer:
 
 
 def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    from gymfx_tpu.train.common import reject_eval_keys
+
+    reject_eval_keys(config, "portfolio")
     env = P.PortfolioEnvironment(config)
     pcfg = PortfolioPPOConfig(
         n_envs=int(config.get("num_envs", 64) or 64),

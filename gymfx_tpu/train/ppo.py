@@ -536,10 +536,11 @@ def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     """CLI mode=training entry: train PPO, optionally checkpoint,
     return a summary merging training metrics and greedy-eval metrics."""
-    env = Environment(config)
-    pcfg = ppo_config_from(config)
     from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
+    from gymfx_tpu.train.common import build_train_eval_envs
 
+    env, eval_env = build_train_eval_envs(config)
+    pcfg = ppo_config_from(config)
     mesh = mesh_from_config(config)
     validate_batch_axis(mesh, pcfg.n_envs, "num_envs")
     trainer = PPOTrainer(env, pcfg, mesh=mesh)
@@ -556,7 +557,17 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         initial_params=resume_params, initial_state=resume_state,
     )
 
-    summary = evaluate(trainer, state.params)
+    # out-of-sample: greedy episode on bars the agent never trained on
+    # (BASELINE metric 2 made scientifically meaningful); the in-sample
+    # numbers ride along for the generalization gap
+    from gymfx_tpu.train.common import labeled_eval_summary
+
+    summary = labeled_eval_summary(
+        lambda e: evaluate(
+            trainer if e is None else PPOTrainer(e, pcfg), state.params
+        ),
+        env, eval_env,
+    )
     summary["train_metrics"] = train_metrics
     if mesh is not None:
         summary["mesh_shape"] = dict(mesh.shape)

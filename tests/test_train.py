@@ -139,6 +139,74 @@ def test_full_state_resume_continues_exact_trajectory(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_eval_split_holds_out_tail_chronologically(tmp_path):
+    """Out-of-sample evaluation (VERDICT r2 weak #3): eval_split holds
+    out the LAST bars; the summary is labeled held_out and carries the
+    in-sample numbers alongside."""
+    from gymfx_tpu.train.common import build_train_eval_envs
+    from gymfx_tpu.train.ppo import train_from_config
+
+    csv = tmp_path / "d.csv"
+    uptrend_df(120).reset_index().to_csv(csv, index=False)
+    config = dict(DEFAULT_VALUES)
+    config.update(input_data_file=str(csv), window_size=8, timeframe="M1",
+                  num_envs=4, ppo_horizon=8, ppo_epochs=1, ppo_minibatches=2,
+                  eval_split=0.25, train_total_steps=64,
+                  policy_kwargs={"hidden": [16]},
+                  save_config=None, results_file=None)
+    tr_env, ev_env = build_train_eval_envs(config)
+    assert tr_env.n_bars == 90 and ev_env.n_bars == 30
+    # chronological: eval bars strictly after the last train bar
+    assert (
+        tr_env.dataset.timestamps.iloc[-1] < ev_env.dataset.timestamps.iloc[0]
+    )
+    summary = train_from_config(config)
+    assert summary["eval_scope"] == "held_out"
+    assert summary["eval_bars"] == 30 and summary["train_bars"] == 90
+    assert "total_return" in summary and "total_return" in summary["in_sample"]
+
+    # both keys together is ambiguous -> loud error
+    config["eval_data_file"] = str(csv)
+    with pytest.raises(ValueError, match="not both"):
+        build_train_eval_envs(config)
+    # a split leaving too few bars is rejected
+    config.pop("eval_data_file")
+    config["eval_split"] = 0.99
+    with pytest.raises(ValueError, match="too few bars"):
+        build_train_eval_envs(config)
+
+
+def test_eval_data_file_evaluates_on_other_dataset(tmp_path):
+    from gymfx_tpu.train.ppo import train_from_config
+
+    train_csv, eval_csv = tmp_path / "tr.csv", tmp_path / "ev.csv"
+    uptrend_df(60).reset_index().to_csv(train_csv, index=False)
+    uptrend_df(40, start_price=1.4).reset_index().to_csv(eval_csv, index=False)
+    config = dict(DEFAULT_VALUES)
+    config.update(input_data_file=str(train_csv), eval_data_file=str(eval_csv),
+                  window_size=8, timeframe="M1", num_envs=4, ppo_horizon=8,
+                  ppo_epochs=1, ppo_minibatches=2, train_total_steps=32,
+                  policy_kwargs={"hidden": [16]},
+                  save_config=None, results_file=None)
+    summary = train_from_config(config)
+    assert summary["eval_scope"] == "held_out"
+    assert summary["eval_bars"] == 40 and summary["train_bars"] == 60
+
+
+def test_impala_eval_split_labels_summary(tmp_path):
+    from gymfx_tpu.train.impala import train_impala_from_config
+
+    csv = tmp_path / "d.csv"
+    uptrend_df(120).reset_index().to_csv(csv, index=False)
+    config = dict(DEFAULT_VALUES)
+    config.update(input_data_file=str(csv), window_size=8, timeframe="M1",
+                  num_envs=4, impala_unroll=8, eval_split=0.25,
+                  train_total_steps=32, save_config=None, results_file=None)
+    summary = train_impala_from_config(config)
+    assert summary["eval_scope"] == "held_out"
+    assert summary["eval_bars"] == 30 and summary["train_bars"] == 90
+
+
 def test_templateless_restore_rebuilds_empty_leaves(tmp_path):
     """Raw (template-less) restore must return the true zero-size
     leaves, not the (1,) placeholders the save masked them with."""
