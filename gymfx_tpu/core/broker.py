@@ -45,6 +45,31 @@ def opening_units(pos, target):
     )
 
 
+def maintenance_margin(pos, price, params: EnvParams, margin_model: str):
+    """Maintenance requirement of the open position, in quote currency:
+    |pos| * price * margin_maint, divided by leverage under the
+    leveraged model — the same model split as the init-margin preflight
+    (reference margin models, simulation_engines/nautilus_adapter.py:397-427)."""
+    m = jnp.abs(pos) * price * params.margin_maint
+    if margin_model == "leveraged":
+        m = m / jnp.maximum(params.leverage, 1e-12)
+    return m
+
+
+def margin_closeout_percent(state: EnvState, price, params: EnvParams,
+                            margin_model: str, cap: float = 100.0):
+    """How close the account is to liquidation: maintenance margin over
+    equity — 0 flat, 1.0 at the closeout boundary, capped when equity is
+    non-positive.  This is the REAL-ledger value behind the
+    ``margin_closeout_percent`` obs field (the reference publishes it
+    from its margin account when one exists, app/env.py:615-623)."""
+    maint = maintenance_margin(state.pos, price, params, margin_model)
+    eq = params.initial_cash + state.equity_delta
+    pct = jnp.where(eq > 0, maint / jnp.maximum(eq, 1e-30), cap)
+    pct = jnp.where(state.pos == 0, 0.0, pct)
+    return jnp.clip(pct, 0.0, cap)
+
+
 def realized_balance(state: EnvState, params: EnvParams):
     """Realized-PnL account balance (initial + realized - commissions):
     cash plus the open position's entry notional — the same measure the

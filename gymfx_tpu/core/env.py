@@ -189,6 +189,30 @@ def step(
     #    re-marks bar 0, which is a no-op on an untouched ledger)
     st_m = broker.mark_to_market(st, c, params)
     st = _select(advance | (live & ~state.started), st_m, st)
+    # 4b. maintenance-margin closeout: equity marked below the position's
+    #     maintenance requirement forces a liquidation that REPLACES any
+    #     pending order and fills at the next bar's open through the
+    #     ordinary order path (slippage and commission apply) — the scan
+    #     twin of Nautilus' margin-account liquidation (reference
+    #     simulation_engines/nautilus_adapter.py:397-427, margin_maint
+    #     contracts.py:117-120).  The agent may re-enter afterwards
+    #     (subject to the init-margin preflight), as on a real venue.
+    if cfg.enforce_margin_closeout:
+        maint = broker.maintenance_margin(st.pos, c, params, cfg.margin_model)
+        equity_now = params.initial_cash + st.equity_delta
+        # gated on `advance`: the exhausted terminal step re-visits the
+        # same mark and would double-count the breach (and its forced
+        # order could never fill — there is no next bar)
+        breach = advance & (st.pos != 0) & (equity_now < maint)
+        st = st._replace(
+            pending_active=st.pending_active | breach,
+            pending_target=jnp.where(breach, 0.0, st.pending_target),
+            pending_sl=jnp.where(breach, 0.0, st.pending_sl),
+            pending_tp=jnp.where(breach, 0.0, st.pending_tp),
+            exec_diag=st.exec_diag.at[EXEC_DIAG_INDEX["margin_closeouts"]].add(
+                breach.astype(jnp.int32)
+            ),
+        )
 
     # streaming obs windows: on advance, shift left and append the new
     # bar's close / raw feature row (raw row i lives at padded[i + w])

@@ -100,7 +100,16 @@ def build_obs(
         for key in CALENDAR_OBS_KEYS:
             obs[key] = cal_map[key][None]
         initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
-        obs["margin_closeout_percent"] = jnp.zeros((1,), dtype=jnp.float32)
+        # real-ledger margin ratio (the reference publishes 0.0 when its
+        # bridge lacks a margin account, app/env.py:615-623; here the
+        # ledger always has one): maintenance margin / equity, 1.0 = at
+        # the liquidation boundary (core/broker.py margin_closeout_percent)
+        from gymfx_tpu.core import broker as _broker
+
+        obs["margin_closeout_percent"] = jnp.asarray(
+            [_broker.margin_closeout_percent(state, price, params, cfg.margin_model)],
+            dtype=jnp.float32,
+        )
         obs["margin_available_norm"] = jnp.asarray(
             [(params.initial_cash + state.equity_delta) / initial],
             dtype=jnp.float32,
@@ -153,7 +162,11 @@ def build_info(
         for i, key in enumerate(CALENDAR_FEATURE_KEYS):
             info[key] = cal[i]
         initial = jnp.where(params.initial_cash == 0, 1.0, params.initial_cash)
-        info["margin_closeout_percent"] = jnp.zeros((), dtype=jnp.float32)
+        from gymfx_tpu.core import broker as _broker
+
+        info["margin_closeout_percent"] = _broker.margin_closeout_percent(
+            state, data.close[state.t], params, cfg.margin_model
+        ).astype(jnp.float32)
         info["margin_available_norm"] = (
             params.initial_cash + state.equity_delta
         ) / initial

@@ -101,6 +101,7 @@ class PortfolioConfig:
     pair_cfg: EnvConfig    # inner per-pair kernel config
     acct_cfg: EnvConfig    # account-level reward/penalty config
     enforce_margin_preflight: bool = False
+    enforce_margin_closeout: bool = False
     margin_model: str = "leveraged"
     dtype: Any = jnp.float32
 
@@ -281,6 +282,31 @@ def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
         ),
     )
 
+    # ---- account maintenance-margin closeout ---------------------------
+    # equity marked below the book's total maintenance requirement
+    # force-flattens EVERY pair at the next bar's open (deterministic
+    # whole-book liquidation; OANDA-style partial closeouts would be
+    # order-dependent).  Forced flats REPLACE any pending orders.
+    if cfg.enforce_margin_closeout:
+        maint = jnp.sum(
+            broker.maintenance_margin(pairs.pos, close, params.pair,
+                                      cfg.margin_model) * conv
+        )
+        equity_now = params.acct.initial_cash + acct.equity_delta
+        # gated on `advance` like the single-pair kernel (core/env.py
+        # step 4b): the exhausted step would double-count the breach
+        breach = advance & jnp.any(pairs.pos != 0) & (equity_now < maint)
+        held = breach & (pairs.pos != 0)
+        pairs = pairs._replace(
+            pending_active=jnp.where(breach, pairs.pos != 0, pairs.pending_active),
+            pending_target=jnp.where(breach, 0.0, pairs.pending_target),
+            pending_sl=jnp.where(breach, 0.0, pairs.pending_sl),
+            pending_tp=jnp.where(breach, 0.0, pairs.pending_tp),
+            exec_diag=pairs.exec_diag.at[:, EXEC_DIAG_INDEX["margin_closeouts"]].add(
+                held.astype(jnp.int32)
+            ),
+        )
+
     acct, base_reward = rewards.compute_reward(acct, cfg.acct_cfg, params.acct, live)
     fc_row = jnp.minimum(t_new + 1, n - 1)
     penalty = rewards.force_close_penalty(
@@ -351,7 +377,23 @@ def _portfolio_obs(obs_i: Dict[str, Any], state: PortfolioState,
             continue
         obs[key] = val[0] if key in shared_keys else val
     if "margin_available_norm" in obs_i:
-        obs["margin_closeout_percent"] = jnp.zeros((1,), jnp.float32)
+        # account-level margin ratio from the real book: total
+        # maintenance requirement over account equity (1.0 = liquidation
+        # boundary), mirroring the single-pair ledger value
+        # (core/broker.py margin_closeout_percent)
+        t = state.acct.t
+        close = data.pair.close[jnp.arange(cfg.n_pairs), t]
+        conv = data.conv[t]
+        maint = jnp.sum(
+            broker.maintenance_margin(state.pairs.pos, close, params.pair,
+                                      cfg.margin_model) * conv
+        )
+        equity = params.acct.initial_cash + state.acct.equity_delta
+        pct = jnp.where(equity > 0, maint / jnp.maximum(equity, 1e-30), 100.0)
+        pct = jnp.where(jnp.any(state.pairs.pos != 0), pct, 0.0)
+        obs["margin_closeout_percent"] = jnp.clip(pct, 0.0, 100.0)[None].astype(
+            jnp.float32
+        )
         obs["margin_available_norm"] = jnp.asarray(
             [(params.acct.initial_cash + state.acct.equity_delta) / initial],
             jnp.float32,
@@ -373,6 +415,9 @@ def _portfolio_info(info_i: Dict[str, Any], state: PortfolioState, conv,
         "commission_paid": jnp.sum(conv * pairs.commission_paid),
         "blocked_margin": jnp.sum(
             pairs.exec_diag[:, EXEC_DIAG_INDEX["preflight_denied"]]
+        ).astype(jnp.int32),
+        "margin_closeouts": jnp.sum(
+            pairs.exec_diag[:, EXEC_DIAG_INDEX["margin_closeouts"]]
         ).astype(jnp.int32),
         "bracket_sl": pairs.bracket_sl,
         "bracket_tp": pairs.bracket_tp,
@@ -433,10 +478,15 @@ class PortfolioEnvironment:
         # as margin_init + enforcement flag
         margin_rate = float(config.get("margin_rate", 0.0) or 0.0)
         enforce = bool(cfg0.enforce_margin_preflight or margin_rate > 0)
+        enforce_closeout = bool(config.get("enforce_margin_closeout", enforce))
         # the inner kernel runs per-pair with the ACCOUNT-level gates off
         pair_cfg = dataclasses.replace(
             cfg0,
             enforce_margin_preflight=False,
+            # margin is an ACCOUNT property: the account-level gates run
+            # in portfolio.step; a per-pair closeout on the pair's own
+            # quote-currency ledger would double-count the shared cash
+            enforce_margin_closeout=False,
             reward="pnl_reward",
             stage_b_force_close_reward_penalty=False,
             allow_flat_action=True,
@@ -451,6 +501,7 @@ class PortfolioEnvironment:
             pair_cfg=pair_cfg,
             acct_cfg=acct_cfg,
             enforce_margin_preflight=enforce,
+            enforce_margin_closeout=enforce_closeout,
             margin_model=cfg0.margin_model,
             dtype=cfg0.dtype,
         )

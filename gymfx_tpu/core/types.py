@@ -42,6 +42,7 @@ EXEC_DIAG_KEYS = (
     "event_context_forced_flat_actions",
     "event_context_forced_flat_orders",
     "preflight_denied",
+    "margin_closeouts",
 )
 EXEC_DIAG_INDEX = {k: i for i, k in enumerate(EXEC_DIAG_KEYS)}
 
@@ -96,6 +97,11 @@ class EnvConfig:
     # no-profile behavior; profiles always set the field explicitly.
     limit_fill_policy: str = "cross"               # conservative | touch | cross
     enforce_margin_preflight: bool = False
+    # maintenance-margin liquidation: equity below the maintenance
+    # requirement at a bar close force-flattens at the next bar open
+    # (reference: Nautilus margin account via margin_maint,
+    # simulation_engines/contracts.py:117-120, nautilus_adapter.py:397-427)
+    enforce_margin_closeout: bool = False
     margin_model: str = "leveraged"                # standard | leveraged
     financing_enabled: bool = False                # FX rollover interest accrual
 
@@ -178,8 +184,9 @@ class EnvParams(NamedTuple):
     force_close_penalty_coef: Any
     force_close_penalty_window_hours: Any
 
-    # margin preflight (instrument initial-margin fraction)
+    # margin (instrument initial / maintenance fractions)
     margin_init: Any
+    margin_maint: Any
 
     # registered third-party kernel parameters ({config_key: scalar});
     # an empty tuple when no custom kernel is selected
@@ -294,6 +301,11 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
             profile.enforce_margin_preflight if profile else False,
         )
     )
+    # maintenance enforcement follows the preflight flag by default (one
+    # venue either runs a margin account or does not — the reference's
+    # Nautilus engine enforces both implicitly); the explicit config key
+    # overrides either way
+    enforce_closeout = bool(config.get("enforce_margin_closeout", enforce_margin))
     margin_model = str(
         config.get("margin_model", profile.margin_model if profile else "leveraged")
     )
@@ -350,6 +362,7 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         intrabar_collision_policy=collision,
         limit_fill_policy=limit_fill,
         enforce_margin_preflight=enforce_margin,
+        enforce_margin_closeout=enforce_closeout,
         margin_model=margin_model,
         financing_enabled=financing,
         dtype=dtype,
@@ -459,6 +472,7 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig, profile=None) -> Env
             config.get("force_close_exposure_penalty_coef", 0.0)
         ),
         margin_init=f(config.get("margin_init", 0.05)),
+        margin_maint=f(config.get("margin_maint", 0.025)),
         force_close_penalty_window_hours=f(
             config.get(
                 "force_close_exposure_penalty_window_hours",

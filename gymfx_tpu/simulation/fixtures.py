@@ -214,6 +214,36 @@ def build_latency_fixture() -> Tuple[
     return eurusd, frames, actions
 
 
+def build_margin_closeout_fixture() -> Tuple[
+    List[InstrumentSpec], List[MarketFrame], List[TargetAction]
+]:
+    """Adverse drift liquidates a leveraged long mid-replay: a 1,000 USD
+    account holds 100,000 EUR/USD from ~1.0 under the leveraged model
+    (leverage 20 -> init margin 250, maintenance 125*price); equity
+    crosses below maintenance at the 0.99100 close, forcing a whole-book
+    closeout that fills at the NEXT frame's tick (reference margin
+    models: simulation_engines/nautilus_adapter.py:397-427)."""
+    spec = InstrumentSpec(
+        symbol="EUR/USD",
+        venue="SIM",
+        base_currency="EUR",
+        quote_currency="USD",
+        price_precision=5,
+        size_precision=0,
+        margin_init=0.05,
+        margin_maint=0.025,
+        min_quantity=1000.0,
+        lot_size=1000.0,
+    )
+    closes = (1.00000, 0.99800, 0.99500, 0.99250, 0.99100, 0.99050)
+    frames = [
+        _bar("EUR/USD.SIM", 1, _ts(minute), close, 0.00015)
+        for minute, close in enumerate(closes, start=1)
+    ]
+    actions = [TargetAction("EUR/USD.SIM", _ts(1), 100_000.0, "doomed-long")]
+    return [spec], frames, actions
+
+
 def build_rollover_rate_fixture() -> pd.DataFrame:
     """Monthly short-rate rows for the fixture currencies (schema of
     examples/data/fx_rollover_rates_smoke.csv)."""

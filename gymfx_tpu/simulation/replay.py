@@ -200,12 +200,21 @@ class ReplayAdapter:
         base_currency: str = "USD",
         default_leverage: float = 20.0,
         financing_rate_data: Any = None,
+        enforce_margin_closeout: Optional[bool] = None,
     ) -> Dict[str, Any]:
         profile = self.profile
         if profile.financing_enabled and financing_rate_data is None:
             raise ValueError(
                 "financing_rate_data is required when financing_enabled is true"
             )
+        # maintenance enforcement follows the preflight flag by default
+        # (one venue either runs a margin account or does not), same rule
+        # as the scan engine (core/types.py make_env_config)
+        enforce_closeout = (
+            bool(profile.enforce_margin_preflight)
+            if enforce_margin_closeout is None
+            else bool(enforce_margin_closeout)
+        )
         venues = {spec.venue for spec in instrument_specs}
         if len(venues) != 1:
             raise ValueError(
@@ -459,24 +468,105 @@ class ReplayAdapter:
                     }
                 )
 
-        for frame in frames_sorted:
-            spec = specs[frame.instrument_id]
-            path: Tuple[float, ...] = tuple(frame.execution_path or (frame.close,))
-            # latency-delayed orders due by now fill at this frame's
-            # first path tick, before bracket evaluation
-            flush_pending(frame, path[0])
-            # walk intrabar ticks: brackets can exit mid-path (book
-            # prices live at the instrument's price precision)
-            for mid in path:
-                bid = make_price(spec, mid * (1.0 - adverse))
-                ask = make_price(spec, mid * (1.0 + adverse))
-                last_mid[frame.instrument_id] = mid
-                check_brackets(frame.instrument_id, bid, ask, mid, frame.ts_event_ns)
-            apply_rollover(frame.ts_event_ns)
+        def check_margin_closeout(ts: int) -> None:
+            """Account-level maintenance check at the end of a frame
+            (its last path tick == the bar close): equity below the
+            maintenance requirement liquidates EVERY open position via a
+            forced market order that fills at the next frame's first
+            path tick — the scan engine's breach-at-close /
+            fill-at-next-open timing (core/env.py step 4b).  Forced
+            closes bypass min_quantity (a venue never strands a
+            liquidation on a size rule)."""
+            nonlocal order_seq, order_count
+            if not enforce_closeout:
+                return
+            if any(po["action_id"] == "margin-closeout" for po in pending_orders):
+                return  # liquidation already in flight
+            equity = balance
+            maint = 0.0
+            any_pos = False
+            for instrument_id, pos in positions.items():
+                if pos.units == 0:
+                    continue
+                any_pos = True
+                spec = specs[instrument_id]
+                mid = mid_of(instrument_id, pos.avg_price)
+                conv = conversion(spec, mid)
+                equity += pos.units * (mid - pos.avg_price) * conv
+                m = abs(pos.units) * mid * float(spec.margin_maint)
+                if profile.margin_model == "leveraged":
+                    m /= max(float(default_leverage), 1e-12)
+                maint += m * conv
+            if not any_pos or equity >= maint:
+                return
+            emit(
+                {
+                    "event_type": "margin_closeout",
+                    "ts_event_ns": int(ts),
+                    "equity": _fmt(equity),
+                    "maintenance_margin": _fmt(maint),
+                    "currency": base_currency,
+                }
+            )
+            # cancel resting brackets and in-flight orders: the venue is
+            # flattening the book (the scan closeout likewise REPLACES
+            # the pending order and its brackets).  Every cancelled
+            # order gets a terminal event so the audit log never holds
+            # a dangling order_submitted.
+            brackets.clear()
+            for po in list(pending_orders):
+                signed = po["qty"] if po["side"] == "BUY" else -po["qty"]
+                inflight_units[po["instrument_id"]] -= signed
+                pending_orders.remove(po)
+                emit(
+                    {
+                        "event_type": "order_canceled",
+                        "ts_event_ns": int(ts),
+                        "instrument_id": po["instrument_id"],
+                        "action_id": po["action_id"],
+                        "client_order_id": po["order_id"],
+                        "reason": "MARGIN_CLOSEOUT",
+                    }
+                )
+            for instrument_id, pos in positions.items():
+                if pos.units == 0:
+                    continue
+                order_seq += 1
+                order_count += 1
+                side = "SELL" if pos.units > 0 else "BUY"
+                qty = abs(pos.units)
+                inflight_units[instrument_id] += -pos.units
+                pending_orders.append(
+                    {
+                        "instrument_id": instrument_id,
+                        "execute_at_ns": int(ts) + 1,
+                        "side": side,
+                        "qty": qty,
+                        "order_id": f"O-{order_seq}",
+                        "action_id": "margin-closeout",
+                        "arm_brackets": False,
+                        "sl": 0.0,
+                        "tp": 0.0,
+                    }
+                )
+                emit(
+                    {
+                        "event_type": "order_submitted",
+                        "ts_event_ns": int(ts),
+                        "instrument_id": instrument_id,
+                        "action_id": "margin-closeout",
+                        "client_order_id": f"O-{order_seq}",
+                        "side": side,
+                        "quantity": _fmt(qty),
+                        "execute_at_ns": int(ts) + 1,
+                    }
+                )
 
+        def process_action(frame: MarketFrame, spec: InstrumentSpec) -> None:
+            nonlocal order_seq, order_count
             action = action_by_key.get((frame.instrument_id, frame.ts_event_ns))
             if action is None:
-                continue
+                return
             pos = positions[frame.instrument_id]
             # net the target against position AND in-flight (latency-
             # delayed) orders so targets stay honored across the window
@@ -495,7 +585,7 @@ class ReplayAdapter:
             )
             active_action[frame.instrument_id] = action.action_id
             if delta == 0:
-                continue
+                return
 
             mid = last_mid[frame.instrument_id]
             side = "BUY" if delta > 0 else "SELL"
@@ -517,7 +607,7 @@ class ReplayAdapter:
                         "min_quantity": _fmt(float(spec.min_quantity)),
                     }
                 )
-                continue
+                return
 
             # units this order would OPEN (fresh entry, add, or the
             # opening leg of a flip) — drives both the margin preflight
@@ -547,7 +637,7 @@ class ReplayAdapter:
                                 "free_balance": _fmt(balance),
                             }
                         )
-                        continue
+                        return
 
             order_seq += 1
             order_count += 1
@@ -592,7 +682,7 @@ class ReplayAdapter:
                         "execute_at_ns": int(execute_at),
                     }
                 )
-                continue
+                return
             fill(
                 frame.instrument_id,
                 side,
@@ -608,6 +698,25 @@ class ReplayAdapter:
                     "sl": make_price(spec, float(action.stop_loss_price)),
                     "tp": make_price(spec, float(action.take_profit_price)),
                 }
+
+        for frame in frames_sorted:
+            spec = specs[frame.instrument_id]
+            path: Tuple[float, ...] = tuple(frame.execution_path or (frame.close,))
+            # latency-delayed orders due by now fill at this frame's
+            # first path tick, before bracket evaluation
+            flush_pending(frame, path[0])
+            # walk intrabar ticks: brackets can exit mid-path (book
+            # prices live at the instrument's price precision)
+            for mid in path:
+                bid = make_price(spec, mid * (1.0 - adverse))
+                ask = make_price(spec, mid * (1.0 + adverse))
+                last_mid[frame.instrument_id] = mid
+                check_brackets(frame.instrument_id, bid, ask, mid, frame.ts_event_ns)
+            apply_rollover(frame.ts_event_ns)
+            process_action(frame, spec)
+            # account maintenance check at the frame end (its last path
+            # tick == the bar close), after any same-frame fills
+            check_margin_closeout(frame.ts_event_ns)
 
         open_positions = sum(1 for p in positions.values() if p.units != 0)
         event_facts = [

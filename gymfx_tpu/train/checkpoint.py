@@ -239,7 +239,10 @@ def _check_leaf(t: Any, r: Any, directory: str) -> Any:
         raise ValueError(
             f"stored leaf shape {tuple(np.shape(r))} != expected {tuple(t.shape)}"
         )
-    return r
+    # cast to the template dtype (what StandardRestore(template) does on
+    # the validated paths) so a float32 legacy save feeds a bfloat16
+    # policy as bfloat16, not as a silent promotion
+    return np.asarray(r, getattr(t, "dtype", None))
 
 
 def _restore_item(

@@ -168,6 +168,12 @@ class Optimizer:
 
 
 def optimize_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    from gymfx_tpu.train.common import reject_eval_keys
+
+    # honor-or-reject: GA fitness is evaluated in-sample on the full
+    # dataset; accepting the out-of-sample keys silently would sell
+    # contaminated numbers as held-out
+    reject_eval_keys(config, "optimization")
     env = Environment(config)
     optimizer = Optimizer(
         env,

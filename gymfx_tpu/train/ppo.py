@@ -520,7 +520,13 @@ def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         config["policy"] = meta["policy"]
         config.setdefault("policy_kwargs", meta.get("policy_kwargs") or {})
 
-    env = Environment(config)
+    # honor the out-of-sample keys: with eval_split/eval_data_file set,
+    # the checkpointed policy is evaluated on the HELD-OUT bars (the
+    # split a prior training run used), not the full training file
+    from gymfx_tpu.train.common import build_train_eval_envs
+
+    train_env, eval_env = build_train_eval_envs(config)
+    env = eval_env if eval_env is not None else train_env
     trainer = PPOTrainer(env, ppo_config_from(config))
     # template-validated restore: an architecture mismatch fails loudly
     # at load time, not as an opaque shape error inside the episode scan
@@ -530,6 +536,7 @@ def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     params, step = load_params(str(ckpt_dir), template=template)
     summary = evaluate(trainer, params, steps=config.get("steps"))
     summary["checkpoint_step"] = step
+    summary["eval_scope"] = "held_out" if eval_env is not None else "in_sample"
     return summary
 
 

@@ -13,7 +13,8 @@ from gymfx_tpu.simulation import ReplayAdapter, fixtures, reconcile_fills
 INITIAL = 100_000.0
 
 
-def _run(fixture_fn=fixtures.build_multi_asset_fixture, profile=None, **kw):
+def _run(fixture_fn=fixtures.build_multi_asset_fixture, profile=None,
+         initial_cash=INITIAL, **kw):
     profile = profile or fixtures.default_profile()
     instruments, frames, actions = fixture_fn()
     adapter = ReplayAdapter(profile)
@@ -21,7 +22,7 @@ def _run(fixture_fn=fixtures.build_multi_asset_fixture, profile=None, **kw):
         instrument_specs=instruments,
         frames=frames,
         actions=actions,
-        initial_cash=INITIAL,
+        initial_cash=initial_cash,
         **kw,
     )
     return instruments, profile, result
@@ -86,6 +87,34 @@ def test_margin_rejection_denies_oversized_order():
     assert denied[0]["reason"] == "CUM_MARGIN_EXCEEDS_FREE_BALANCE"
     assert fills == []
     assert float(result["summary"]["final_balance"]) == INITIAL
+
+
+def test_margin_closeout_fixture_liquidates_and_reconciles():
+    """Maintenance breach liquidates mid-replay and the oracle
+    reconciles the forced fill like any other (VERDICT r3 item #3)."""
+    instruments, profile, result = _run(
+        fixtures.build_margin_closeout_fixture,
+        initial_cash=1000.0,
+        default_leverage=20.0,
+    )
+    events = result["events"]
+    closeouts = [e for e in events if e["event_type"] == "margin_closeout"]
+    assert len(closeouts) == 1
+    forced = [
+        e for e in events
+        if e["event_type"] == "order_filled"
+        and e["action_id"] == "margin-closeout"
+    ]
+    assert len(forced) == 1
+    assert result["summary"]["positions_open"] == 0
+    oracle = reconcile_fills(
+        result, instruments, profile, initial_cash=1000.0
+    )
+    native_final = float(result["summary"]["final_balance"])
+    assert oracle["all_positions_flat"]
+    assert abs(native_final - oracle["expected_final_balance"]) <= 0.02
+    # the closeout rescued the account: broke but not bankrupt
+    assert 0.0 < native_final < 250.0
 
 
 def test_financing_accrues_over_rollover():

@@ -160,10 +160,23 @@ def test_eval_split_holds_out_tail_chronologically(tmp_path):
     assert (
         tr_env.dataset.timestamps.iloc[-1] < ev_env.dataset.timestamps.iloc[0]
     )
+    config["checkpoint_dir"] = str(tmp_path / "ck")
     summary = train_from_config(config)
     assert summary["eval_scope"] == "held_out"
     assert summary["eval_bars"] == 30 and summary["train_bars"] == 90
     assert "total_return" in summary and "total_return" in summary["in_sample"]
+
+    # driver_mode=policy honors the same split: the checkpointed policy
+    # is evaluated on the held-out tail, not the full training file
+    from gymfx_tpu.train.ppo import eval_policy_from_config
+
+    pe = eval_policy_from_config(dict(config))
+    assert pe["eval_scope"] == "held_out"
+    # optimization mode must reject the keys it cannot honor
+    from gymfx_tpu.train.optimize import optimize_from_config
+
+    with pytest.raises(ValueError, match="optimization"):
+        optimize_from_config(dict(config))
 
     # both keys together is ambiguous -> loud error
     config["eval_data_file"] = str(csv)
