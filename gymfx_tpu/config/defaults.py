@@ -39,6 +39,10 @@ DEFAULT_VALUES = {
     "slippage": 0.0,
     "leverage": 1.0,
     "min_equity": None,  # default: 1% of initial_cash (reference app/env.py:122)
+    # opt-in scan-engine venue quantization: fills/brackets on the
+    # instrument's tick grid, order sizes on its size step, min_quantity
+    # denial — the replay venue's book semantics (DIVERGENCES #9d closed)
+    "venue_quantization": False,
     "action_space_mode": "discrete",  # discrete|continuous
     "continuous_action_threshold": 0.33,
     "seed": 0,

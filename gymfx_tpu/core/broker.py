@@ -31,7 +31,21 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
-from gymfx_tpu.core.types import EnvConfig, EnvParams, EnvState
+from gymfx_tpu.core.types import (
+    EXEC_DIAG_INDEX,
+    EnvConfig,
+    EnvParams,
+    EnvState,
+)
+
+
+def quantize(x, tick):
+    """Round ``x`` to the nearest multiple of ``tick``; identity when
+    tick == 0 (the venue-quantization-off sentinel).  Round-half-even,
+    matching the replay venue's ``make_price``/``make_qty`` (Python
+    ``round``) so both engines land on the same grid."""
+    safe = jnp.where(tick > 0, tick, 1.0)
+    return jnp.where(tick > 0, jnp.round(x / safe) * safe, x)
 
 
 def opening_units(pos, target):
@@ -92,7 +106,12 @@ def apply_fill(
     target = jnp.asarray(target_units, dtype=d)
     delta = target - pos
     direction = jnp.sign(delta)
-    fill = fill_price * (1.0 + params.slippage * direction)
+    # venue quantization (opt-in): the book holds prices at the
+    # instrument's tick, so the post-slippage fill price is quantized —
+    # the replay venue's make_price on bid/ask (simulation/replay.py
+    # market_price)
+    fill = quantize(fill_price * (1.0 + params.slippage * direction),
+                    params.price_tick)
 
     abs_pos = jnp.abs(pos)
     abs_target = jnp.abs(target)
@@ -157,8 +176,29 @@ def apply_fill(
 
 
 def fill_pending(state: EnvState, open_price, params: EnvParams) -> EnvState:
-    """Execute the pending market order at the new bar's open."""
-    target = jnp.where(state.pending_active, state.pending_target, state.pos)
+    """Execute the pending market order at the new bar's open.
+
+    Venue quantization (opt-in, zero-sentinel params): the order DELTA
+    is rounded to the instrument's size step and orders below
+    min_quantity are denied — the replay venue's make_qty/min_quantity
+    rule (simulation/replay.py process_action; reference RiskEngine,
+    nautilus_adapter.py:190).  Denials apply to closing orders too,
+    exactly like the replay engine.
+    """
+    raw_target = jnp.where(state.pending_active, state.pending_target, state.pos)
+    delta = raw_target - state.pos
+    qty = quantize(jnp.abs(delta), params.size_step)
+    denied = (
+        state.pending_active
+        & (delta != 0)
+        & ((qty < params.min_qty) | ((params.size_step > 0) & (qty <= 0)))
+    )
+    target = jnp.where(denied, state.pos, state.pos + jnp.sign(delta) * qty)
+    state = state._replace(
+        exec_diag=state.exec_diag.at[
+            EXEC_DIAG_INDEX["order_denied_min_quantity"]
+        ].add(denied.astype(jnp.int32))
+    )
     new_state = apply_fill(state, open_price, target, params)
     # Re-arm brackets only when the fill actually OPENED units (fresh
     # entry or flip) — a fill that merely reduces an existing bracketed
@@ -169,8 +209,14 @@ def fill_pending(state: EnvState, open_price, params: EnvParams) -> EnvState:
         & (new_state.pos != 0)
         & (opening_units(state.pos, target) > 0)
     )
-    bracket_sl = jnp.where(entered, state.pending_sl, state.bracket_sl)
-    bracket_tp = jnp.where(entered, state.pending_tp, state.bracket_tp)
+    # bracket levels rest on the venue book -> quantized at arming (the
+    # replay's make_price on sl/tp; identity when quantization is off)
+    bracket_sl = jnp.where(
+        entered, quantize(state.pending_sl, params.price_tick), state.bracket_sl
+    )
+    bracket_tp = jnp.where(
+        entered, quantize(state.pending_tp, params.price_tick), state.bracket_tp
+    )
     flat = new_state.pos == 0
     return new_state._replace(
         pending_active=jnp.zeros_like(state.pending_active),

@@ -43,6 +43,7 @@ EXEC_DIAG_KEYS = (
     "event_context_forced_flat_orders",
     "preflight_denied",
     "margin_closeouts",
+    "order_denied_min_quantity",
 )
 EXEC_DIAG_INDEX = {k: i for i, k in enumerate(EXEC_DIAG_KEYS)}
 
@@ -187,6 +188,15 @@ class EnvParams(NamedTuple):
     # margin (instrument initial / maintenance fractions)
     margin_init: Any
     margin_maint: Any
+
+    # opt-in venue quantization (0 = off): book-price tick, order-size
+    # step, minimum order quantity — the scan twins of the replay
+    # venue's make_price/make_qty/min_quantity (simulation/replay.py;
+    # reference nautilus_adapter.py:111-113,190).  Params-only sentinel
+    # design: enabling it never recompiles the step.
+    price_tick: Any = 0.0
+    size_step: Any = 0.0
+    min_qty: Any = 0.0
 
     # registered third-party kernel parameters ({config_key: scalar});
     # an empty tuple when no custom kernel is selected
@@ -473,6 +483,7 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig, profile=None) -> Env
         ),
         margin_init=f(config.get("margin_init", 0.05)),
         margin_maint=f(config.get("margin_maint", 0.025)),
+        **_venue_quantization_params(config, f),
         force_close_penalty_window_hours=f(
             config.get(
                 "force_close_exposure_penalty_window_hours",
@@ -481,6 +492,23 @@ def make_env_params(config: Dict[str, Any], cfg: EnvConfig, profile=None) -> Env
         ),
         user=_user_params(config, cfg, f),
     )
+
+
+def _venue_quantization_params(config: Dict[str, Any], f) -> Dict[str, Any]:
+    """Opt-in (``venue_quantization: true``): derive tick/step/min-qty
+    from the instrument spec resolved exactly as the replay engine does
+    (contracts.instrument_spec_from_config), so both engines quantize to
+    the same grid.  Off -> zero sentinels, the step is untouched."""
+    if not config.get("venue_quantization"):
+        return {"price_tick": f(0.0), "size_step": f(0.0), "min_qty": f(0.0)}
+    from gymfx_tpu.contracts import instrument_spec_from_config
+
+    spec = instrument_spec_from_config(config)
+    return {
+        "price_tick": f(10.0 ** (-spec.price_precision)),
+        "size_step": f(10.0 ** (-spec.size_precision)),
+        "min_qty": f(spec.min_quantity),
+    }
 
 
 def _user_params(config: Dict[str, Any], cfg: EnvConfig, f) -> Any:

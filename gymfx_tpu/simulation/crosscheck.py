@@ -290,7 +290,11 @@ def crosscheck_episode(
     tick = 10.0 ** (-spec.price_precision)
     max_price = float(np.max(c))
     dtype_eps = 3.0 * float(jnp.finfo(env.cfg.dtype).eps) * max_price
-    per_unit = tick / 2.0 + dtype_eps
+    # with scan-side venue quantization enabled (venue_quantization
+    # config key) both engines land fills on the same tick grid, so the
+    # half-tick term disappears and only compute-dtype rounding remains
+    scan_quantized = float(np.asarray(jax.device_get(env.params.price_tick))) > 0
+    per_unit = dtype_eps if scan_quantized else tick / 2.0 + dtype_eps
     if (
         profile.limit_fill_policy == "cross"
         and profile.quote_adverse_rate_per_side > 0

@@ -61,6 +61,76 @@ def test_costed_episode_within_quantization_bound():
     assert result["quantization_bound"] < 2.0
 
 
+def test_venue_quantization_closes_the_divergence(tmp_path):
+    """Opt-in scan-side venue quantization (VERDICT r3 item #6): with
+    ``venue_quantization: true`` both engines fill on the same tick
+    grid, the half-tick term drops out of the bound, and a costed
+    episode reconciles to compute-dtype rounding."""
+    base = _config(
+        driver_mode="random", steps=300, execution_cost_profile=PROFILE,
+        venue_quantization=True,
+    )
+    result = crosscheck_episode(base, seed=3)
+    assert result["replay_fills"] > 50
+    assert result["within_bound"], result
+    # the bound collapsed to dtype eps (~0.1 on 200k filled units):
+    # an order of magnitude below the unquantized half-tick bound
+    # (fills x units x tick/2 ~ 1.0+)
+    assert result["quantization_bound"] < 0.2, result["quantization_bound"]
+    unq = crosscheck_episode(
+        _config(driver_mode="random", steps=300,
+                execution_cost_profile=PROFILE),
+        seed=3,
+    )
+    assert result["quantization_bound"] < unq["quantization_bound"] / 5.0
+
+
+def test_venue_quantization_denies_below_min_quantity():
+    """A fractional target below min_quantity is denied by the scan
+    venue (counter increments, no fill) — the replay's
+    ORDER_BELOW_MIN_QUANTITY rule (reference RiskEngine,
+    nautilus_adapter.py:190)."""
+    from gymfx_tpu.core.types import EXEC_DIAG_INDEX
+    from tests.helpers import make_df, make_env
+
+    closes = [1.0 + 0.0001 * i for i in range(12)]
+    env = make_env(
+        make_df(closes), position_size=0.5, venue_quantization=True,
+        min_quantity=1.0, size_precision=0,
+    )
+    assert float(env.params.min_qty) == 1.0
+    state, obs = env.reset()
+    state, *_ = env.step(state, 1)   # try to go long 0.5 units
+    state, *_ = env.step(state, 0)   # would-be fill bar
+    assert float(state.pos) == 0.0   # denied, not filled
+    assert int(state.exec_diag[EXEC_DIAG_INDEX["order_denied_min_quantity"]]) == 1
+    # quantization off (default): the same fractional order fills
+    env2 = make_env(make_df(closes), position_size=0.5)
+    s2, _ = env2.reset()
+    s2, *_ = env2.step(s2, 1)
+    s2, *_ = env2.step(s2, 0)
+    assert float(s2.pos) == 0.5
+
+
+def test_venue_quantization_rounds_sizes_and_prices():
+    from tests.helpers import make_df, make_env
+
+    closes = [1.000013, 1.000117, 1.000219, 1.000331, 1.000447, 1.000529]
+    env = make_env(
+        make_df(closes), position_size=1000.7, venue_quantization=True,
+        slippage=0.0001,
+    )
+    state, obs = env.reset()
+    state, *_ = env.step(state, 1)
+    state, *_ = env.step(state, 0)
+    # size rounded to the (size_precision=0) unit grid
+    assert float(state.pos) == 1001.0
+    # entry price on the 1e-5 tick grid despite slippage displacement
+    # (to f32 compute-dtype precision, ~6e-8 at price 1.0)
+    entry = float(state.entry_price)
+    assert abs(entry * 1e5 - round(entry * 1e5)) < 0.01
+
+
 def test_explicit_action_stream_with_coerced_flat_action():
     """Action 3 is coerced to hold by the env (allow_flat_action off);
     the cross-check must model the same coercion."""
