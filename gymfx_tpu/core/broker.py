@@ -43,7 +43,21 @@ def quantize(x, tick):
     """Round ``x`` to the nearest multiple of ``tick``; identity when
     tick == 0 (the venue-quantization-off sentinel).  Round-half-even,
     matching the replay venue's ``make_price``/``make_qty`` (Python
-    ``round``) so both engines land on the same grid."""
+    ``round``) so both engines land on the same grid.
+
+    The arithmetic runs in float64 when x64 is enabled (bit-parity with
+    the replay venue's double rounding).  In pure-f32 mode the ratio
+    ``x/tick`` (~1e5 for FX ticks) keeps only ~7 fractional bits, so a
+    value within ~0.01 tick of a midpoint can round to the adjacent
+    tick vs the f64 path — the crosscheck bound carries a documented
+    midpoint-flip slack for exactly this (simulation/crosscheck.py)."""
+    import jax
+
+    x = jnp.asarray(x)
+    if jax.config.jax_enable_x64:
+        xi, ti = x.astype(jnp.float64), jnp.asarray(tick, jnp.float64)
+        safe = jnp.where(ti > 0, ti, 1.0)
+        return jnp.where(ti > 0, jnp.round(xi / safe) * safe, xi).astype(x.dtype)
     safe = jnp.where(tick > 0, tick, 1.0)
     return jnp.where(tick > 0, jnp.round(x / safe) * safe, x)
 
@@ -175,7 +189,10 @@ def apply_fill(
     )
 
 
-def fill_pending(state: EnvState, open_price, params: EnvParams) -> EnvState:
+def fill_pending(
+    state: EnvState, open_price, params: EnvParams,
+    cfg: EnvConfig = None, high=None, low=None,
+) -> EnvState:
     """Execute the pending market order at the new bar's open.
 
     Venue quantization (opt-in, zero-sentinel params): the order DELTA
@@ -184,12 +201,28 @@ def fill_pending(state: EnvState, open_price, params: EnvParams) -> EnvState:
     rule (simulation/replay.py process_action; reference RiskEngine,
     nautilus_adapter.py:190).  Denials apply to closing orders too,
     exactly like the replay engine.
+
+    Per-fill-type slippage switches (reference backtrader
+    set_slippage_perc, broker_plugins/default_broker.py:52): with
+    ``cfg.slip_open`` off, fills at the open take no slippage; with
+    ``cfg.slip_match`` on (and ``high``/``low`` given), the slipped
+    price is capped into the bar's range.  The default flags take the
+    untouched code path — bit-identical to the pre-toggle kernel.
     """
     raw_target = jnp.where(state.pending_active, state.pending_target, state.pos)
     delta = raw_target - state.pos
     qty = quantize(jnp.abs(delta), params.size_step)
+    # A venue-forced liquidation (maintenance-margin closeout) bypasses the
+    # size rules entirely: it fills the exact open position, un-quantized
+    # and below min_quantity if need be — the replay venue's bypass
+    # (simulation/replay.py check_margin_closeout: "a venue never strands
+    # a liquidation on a size rule").  Without this a position left below
+    # min_qty by partial reduces would be permanently unliquidatable.
+    forced = state.pending_active & state.pending_forced
+    qty = jnp.where(forced, jnp.abs(delta), qty)
     denied = (
         state.pending_active
+        & ~forced
         & (delta != 0)
         & ((qty < params.min_qty) | ((params.size_step > 0) & (qty <= 0)))
     )
@@ -199,7 +232,21 @@ def fill_pending(state: EnvState, open_price, params: EnvParams) -> EnvState:
             EXEC_DIAG_INDEX["order_denied_min_quantity"]
         ].add(denied.astype(jnp.int32))
     )
-    new_state = apply_fill(state, open_price, target, params)
+    fill_price = open_price
+    slip_open = cfg.slip_open if cfg is not None else True
+    slip_match = (cfg.slip_match if cfg is not None else False) and high is not None
+    if (not slip_open) or slip_match:
+        # pre-adjust so apply_fill's own slippage lands on the desired
+        # final price (the same neutralization trick as the TP path)
+        direction = jnp.sign(target - state.pos)
+        final = open_price * (
+            1.0 + params.slippage * (1.0 if slip_open else 0.0) * direction
+        )
+        if slip_match:
+            final = jnp.clip(final, low, high)
+        denom = 1.0 + params.slippage * direction
+        fill_price = final / jnp.where(denom == 0, 1.0, denom)
+    new_state = apply_fill(state, fill_price, target, params)
     # Re-arm brackets only when the fill actually OPENED units (fresh
     # entry or flip) — a fill that merely reduces an existing bracketed
     # position must not overwrite its live brackets with the reduce
@@ -223,6 +270,7 @@ def fill_pending(state: EnvState, open_price, params: EnvParams) -> EnvState:
         pending_target=jnp.zeros_like(state.pending_target),
         pending_sl=jnp.zeros_like(state.pending_sl),
         pending_tp=jnp.zeros_like(state.pending_tp),
+        pending_forced=jnp.zeros_like(state.pending_forced),
         bracket_sl=jnp.where(flat, 0.0, bracket_sl),
         bracket_tp=jnp.where(flat, 0.0, bracket_tp),
     )
@@ -296,15 +344,39 @@ def check_brackets(
 
     exiting = exit_sl | exit_tp
     # SL exits suffer adverse slippage (stop -> market); TP exits fill at
-    # the limit price exactly (a limit cannot fill worse than its price).
+    # the limit price exactly (a limit cannot fill worse than its price)
+    # unless cfg.slip_limit re-enables slippage on them (capped at the
+    # limit).  cfg.slip_open / cfg.slip_match adjust gap and intrabar
+    # fills per the reference broker's set_slippage_perc switches; the
+    # default flags take the original code path bit-for-bit.
     exit_dir = -jnp.sign(pos)  # sell to exit long, buy to exit short
-    raw_price = jnp.where(exit_sl, sl_fill, tp_fill)
-    # apply_fill applies params.slippage itself; neutralize for TP by
-    # pre-adjusting the price so the post-slippage fill equals the limit.
     denom = 1.0 + params.slippage * exit_dir
-    adj_price = jnp.where(
-        exit_sl, raw_price, raw_price / jnp.where(denom == 0, 1.0, denom)
-    )
+    safe_denom = jnp.where(denom == 0, 1.0, denom)
+    if cfg.slip_open and not cfg.slip_match:
+        sl_adj = sl_fill  # apply_fill slips it (historical path)
+    else:
+        sl_gap = has_pos & has_sl & jnp.where(
+            long, open_price <= sl, open_price >= sl
+        )
+        # gap SLs execute at the open (slip_open gates them); intrabar
+        # stop fills always slip
+        sl_scale = jnp.where(sl_gap, 1.0 if cfg.slip_open else 0.0, 1.0)
+        sl_final = sl_fill * (1.0 + params.slippage * sl_scale * exit_dir)
+        if cfg.slip_match:
+            sl_final = jnp.clip(sl_final, low, high)
+        sl_adj = sl_final / safe_denom
+    if cfg.slip_limit:
+        tp_final = tp_fill * (1.0 + params.slippage * exit_dir)
+        if cfg.slip_match:
+            tp_final = jnp.clip(tp_final, low, high)
+        # a limit never fills worse than its price (cap applied last)
+        tp_final = jnp.where(
+            long, jnp.maximum(tp_final, tp), jnp.minimum(tp_final, tp)
+        )
+        tp_adj = tp_final / safe_denom
+    else:
+        tp_adj = tp_fill / safe_denom  # neutralize: fill at the limit exactly
+    adj_price = jnp.where(exit_sl, sl_adj, tp_adj)
 
     target = jnp.where(exiting, 0.0, pos)
     new_state = apply_fill(state, jnp.where(exiting, adj_price, open_price), target, params)

@@ -142,7 +142,7 @@ def step(
     st = state._replace(t=t_new, last_trade_cost=jnp.zeros_like(state.last_trade_cost))
 
     # 1. pending order fills at the new bar's open (only when advancing)
-    st_f = broker.fill_pending(st, o, params)
+    st_f = broker.fill_pending(st, o, params, cfg, h, l)
     st = _select(advance, st_f, st)
     # 2. brackets resolve against the new bar's H/L
     st_b = broker.check_brackets(st, o, h, l, cfg, params)
@@ -209,6 +209,7 @@ def step(
             pending_target=jnp.where(breach, 0.0, st.pending_target),
             pending_sl=jnp.where(breach, 0.0, st.pending_sl),
             pending_tp=jnp.where(breach, 0.0, st.pending_tp),
+            pending_forced=st.pending_forced | breach,
             exec_diag=st.exec_diag.at[EXEC_DIAG_INDEX["margin_closeouts"]].add(
                 breach.astype(jnp.int32)
             ),

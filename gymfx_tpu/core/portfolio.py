@@ -302,6 +302,7 @@ def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
             pending_target=jnp.where(breach, 0.0, pairs.pending_target),
             pending_sl=jnp.where(breach, 0.0, pairs.pending_sl),
             pending_tp=jnp.where(breach, 0.0, pairs.pending_tp),
+            pending_forced=pairs.pending_forced | held,
             exec_diag=pairs.exec_diag.at[:, EXEC_DIAG_INDEX["margin_closeouts"]].add(
                 held.astype(jnp.int32)
             ),
@@ -446,7 +447,13 @@ _STATIC_PROFILE_FIELDS = (
 class PortfolioEnvironment:
     """Host-side binding: pair CSVs -> jitted portfolio reset/step."""
 
-    def __init__(self, config: Dict[str, Any]):
+    def __init__(self, config: Dict[str, Any],
+                 split: Optional[Tuple[str, float]] = None):
+        """``split=("train"|"eval", frac)`` applies the chronological
+        out-of-sample split AFTER the cross-pair timestamp join: the
+        last ``frac`` of the ALIGNED bars is the eval part, so the two
+        parts never share a bar on any pair (train/common.py
+        build_portfolio_train_eval_envs)."""
         files = config.get("portfolio_files")
         if not files:
             raise ValueError("portfolio env requires config['portfolio_files']")
@@ -460,6 +467,24 @@ class PortfolioEnvironment:
         )
         self.pairs = pairs
         w = int(config.get("window_size", 32))
+        if split is not None:
+            part, frac = split
+            frac = float(frac)
+            if part not in ("train", "eval"):
+                raise ValueError(f"split part must be train|eval, got {part!r}")
+            if not 0.0 < frac < 1.0:
+                raise ValueError(f"eval_split must be in (0, 1), got {frac!r}")
+            n_all = len(next(iter(aligned.values())))
+            cut = n_all - int(n_all * frac)
+            min_bars = w + 2
+            if cut < min_bars or n_all - cut < min_bars:
+                raise ValueError(
+                    f"eval_split={frac} leaves too few aligned bars (train "
+                    f"{cut}, eval {n_all - cut}; both need >= {min_bars})"
+                )
+            sl = slice(0, cut) if part == "train" else slice(cut, None)
+            aligned = {p: df.iloc[sl] for p, df in aligned.items()}
+        self.timestamps = next(iter(aligned.values())).index
         n = len(next(iter(aligned.values())))
         if n < w + 2:
             raise ValueError("aligned portfolio data too short for the window")
@@ -567,6 +592,11 @@ class PortfolioEnvironment:
         bar_ms = datasets[0].bar_interval_ms()
         for prof in profiles:
             validate_profile_latency(prof, bar_ms)
+        self.timeframe_hours = datasets[0].timeframe_hours
+
+    @property
+    def n_bars(self) -> int:
+        return self.cfg.n_bars
 
     @staticmethod
     def _load_profiles(config: Dict[str, Any], pairs: List[str]):

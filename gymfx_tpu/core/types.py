@@ -106,6 +106,16 @@ class EnvConfig:
     margin_model: str = "leveraged"                # standard | leveraged
     financing_enabled: bool = False                # FX rollover interest accrual
 
+    # per-fill-type slippage switches — the reference broker's
+    # set_slippage_perc(slip_open, slip_limit, slip_match)
+    # (broker_plugins/default_broker.py:52, backtrader semantics).
+    # Scan defaults keep the engine's historical behavior (market/stop
+    # fills slip, limit fills exempt, no bar-range cap); the reference's
+    # backtrader run enables all three — set them in the config to match.
+    slip_open: bool = True    # slippage on fills executing at the bar open
+    slip_limit: bool = False  # slippage on limit (TP) fills, capped at the limit price
+    slip_match: bool = False  # cap slipped fill prices into the bar's [low, high]
+
     dtype: Any = jnp.float32
 
     def __post_init__(self):
@@ -225,6 +235,12 @@ class EnvState(NamedTuple):
     pending_target: Any    # desired signed units
     pending_sl: Any        # bracket prices to arm after fill (0 = none)
     pending_tp: Any
+    # venue-forced liquidation flag: the pending order was created by the
+    # maintenance-margin closeout, not the agent — it bypasses the venue's
+    # min-quantity/size-step rules exactly like the replay engine's
+    # liquidation ("a venue never strands a liquidation on a size rule",
+    # simulation/replay.py check_margin_closeout)
+    pending_forced: Any    # bool
 
     # active bracket on the open position (0 = none)
     bracket_sl: Any
@@ -371,6 +387,9 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         ),
         intrabar_collision_policy=collision,
         limit_fill_policy=limit_fill,
+        slip_open=bool(config.get("slip_open", True)),
+        slip_limit=bool(config.get("slip_limit", False)),
+        slip_match=bool(config.get("slip_match", False)),
         enforce_margin_preflight=enforce_margin,
         enforce_margin_closeout=enforce_closeout,
         margin_model=margin_model,
@@ -546,6 +565,7 @@ def initial_state(cfg: EnvConfig) -> EnvState:
         pending_target=z,
         pending_sl=z,
         pending_tp=z,
+        pending_forced=jnp.zeros((), dtype=bool),
         bracket_sl=z,
         bracket_tp=z,
         trade_pnl_sum=z,

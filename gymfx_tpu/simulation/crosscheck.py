@@ -159,6 +159,17 @@ def crosscheck_episode(
             "(both engines' financing is cross-checked by "
             "tests/test_execution_profile.py)"
         )
+    slip_rate = float(np.asarray(jax.device_get(env.params.slippage)))
+    if slip_rate > 0 and (
+        env.cfg.slip_limit or env.cfg.slip_match or not env.cfg.slip_open
+    ):
+        raise ValueError(
+            "crosscheck models the replay venue's uniform adverse "
+            "displacement; non-default per-fill-type slippage switches "
+            "(slip_open/slip_limit/slip_match) are a scan-engine feature "
+            "mirroring the reference's backtrader broker — disable them "
+            "or set slippage to 0 for cross-checking"
+        )
     bar_ms = env.dataset.bar_interval_ms()
     if not bar_ms:
         raise ValueError("crosscheck requires timestamped bars")
@@ -292,16 +303,41 @@ def crosscheck_episode(
     dtype_eps = 3.0 * float(jnp.finfo(env.cfg.dtype).eps) * max_price
     # with scan-side venue quantization enabled (venue_quantization
     # config key) both engines land fills on the same tick grid, so the
-    # half-tick term disappears and only compute-dtype rounding remains
+    # half-tick term disappears and only compute-dtype rounding remains —
+    # plus a midpoint-flip allowance: the scan computes prices (and the
+    # quantize ratio x/tick, ~1e5) at the env compute dtype, so a fill
+    # whose true value lies within that dtype's error band of a tick
+    # midpoint can round to the ADJACENT tick vs the replay's float64
+    # rounding — a full-tick divergence on that fill's units.  The
+    # allowance below covers the worst single fill flipping in full plus
+    # the band-width fraction of the remaining units (a fill is at risk
+    # only inside the band); it is a high-confidence check, not a proof:
+    # several LARGE near-midpoint fills in one episode could exceed it.
+    # x64 narrows the band (quantize then runs in f64, broker.quantize)
+    # but f32-computed pre-quantize prices keep it nonzero whenever
+    # slippage scales the price.
     scan_quantized = float(np.asarray(jax.device_get(env.params.price_tick))) > 0
-    per_unit = dtype_eps if scan_quantized else tick / 2.0 + dtype_eps
+    filled_units = sum(float(f["quantity"]) for f in fills)
+    max_fill_qty = max((float(f["quantity"]) for f in fills), default=0.0)
+    flip_allowance = 0.0
+    if scan_quantized:
+        per_unit = dtype_eps
+        exact = jax.config.jax_enable_x64 and slip_rate == 0.0 and (
+            profile.quote_adverse_rate_per_side == 0.0
+        )
+        if not exact:
+            band = min(
+                1.0, 2.0 * float(jnp.finfo(env.cfg.dtype).eps) * max_price / tick
+            )
+            flip_allowance = tick * (band * filled_units + max_fill_qty)
+    else:
+        per_unit = tick / 2.0 + dtype_eps
     if (
         profile.limit_fill_policy == "cross"
         and profile.quote_adverse_rate_per_side > 0
     ):
         per_unit += profile.quote_adverse_rate_per_side * max_price
-    filled_units = sum(float(f["quantity"]) for f in fills)
-    quantization_bound = filled_units * per_unit + 0.01
+    quantization_bound = filled_units * per_unit + flip_allowance + 0.01
 
     return {
         "schema": "scan_replay_crosscheck.v2",

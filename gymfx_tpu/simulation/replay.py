@@ -715,7 +715,14 @@ class ReplayAdapter:
             apply_rollover(frame.ts_event_ns)
             process_action(frame, spec)
             # account maintenance check at the frame end (its last path
-            # tick == the bar close), after any same-frame fills
+            # tick == the bar close), after any same-frame fills.  This
+            # deliberately runs on the FINAL frame too: the scan engine
+            # counts a breach detected at the final bar close (its
+            # `advance` gate only suppresses the exhausted re-visit,
+            # tests/test_margin_closeout.py final-bar test), so the
+            # matching replay behavior is one margin_closeout event with
+            # the forced order left pending-unexecuted — the twin of the
+            # scan's never-filled pending_active order.
             check_margin_closeout(frame.ts_event_ns)
 
         open_positions = sum(1 for p in positions.values() if p.units != 0)

@@ -180,6 +180,54 @@ def load_params(directory: str, template: Optional[Any] = None) -> Tuple[Any, in
     return tree, step
 
 
+# EnvState fields added AFTER a release that shipped full-state
+# checkpoints, with their backfill default: restores of older composite
+# checkpoints synthesize these instead of failing (each entry documents
+# the round that added the field).
+_MIGRATED_FIELDS = {
+    "pending_forced",  # r4: venue-forced liquidation flag (False at rest)
+}
+
+
+def _rebuild_like(template: Any, raw: Any, path: str = "") -> Any:
+    """Rebuild ``raw`` (orbax's dict/list structure) into the template's
+    NamedTuple/dict/tuple structure, synthesizing zero-leaves for fields
+    in ``_MIGRATED_FIELDS`` that the stored tree predates.  Leaves are
+    shape-checked and cast to the template dtype (like _check_leaf)."""
+    if hasattr(template, "_asdict"):
+        fields = template._asdict()
+        vals = {}
+        for k, tv in fields.items():
+            if isinstance(raw, dict) and k in raw:
+                vals[k] = _rebuild_like(tv, raw[k], f"{path}.{k}")
+            elif k in _MIGRATED_FIELDS and hasattr(tv, "shape"):
+                vals[k] = np.zeros(tv.shape, np.dtype(tv.dtype))
+            else:
+                raise KeyError(
+                    f"checkpoint tree is missing field {path}.{k} and it "
+                    "is not a known migrated field"
+                )
+        return type(template)(**vals)
+    if isinstance(template, dict):
+        return {
+            k: _rebuild_like(tv, raw[k], f"{path}.{k}")
+            for k, tv in template.items()
+        }
+    if isinstance(template, (list, tuple)):
+        return type(template)(
+            _rebuild_like(t, r, f"{path}[{i}]")
+            for i, (t, r) in enumerate(zip(template, raw))
+        )
+    if _is_empty(template):
+        return np.zeros(template.shape, np.dtype(template.dtype))
+    if hasattr(template, "shape") and tuple(template.shape) != tuple(np.shape(raw)):
+        raise ValueError(
+            f"stored leaf {path} shape {tuple(np.shape(raw))} != expected "
+            f"{tuple(template.shape)}"
+        )
+    return np.asarray(raw, getattr(template, "dtype", None))
+
+
 def load_train_state(directory: str, trainer: Any, state_cls: Any):
     """Resume helper shared by the trainers: returns
     ``(initial_state, initial_params, step)`` — a full train state when
@@ -189,11 +237,21 @@ def load_train_state(directory: str, trainer: Any, state_cls: Any):
     template source); ``state_cls`` is its train-state NamedTuple.
     """
     if read_metadata(directory).get("state_format") in ("composite", "train_state"):
-        template = jax.eval_shape(
+        template_nt = jax.eval_shape(
             trainer.init_state_from_key, jax.random.PRNGKey(0)
-        )._asdict()
-        restored, step = load_checkpoint(directory, template=template)
-        return state_cls(**restored), None, step
+        )
+        try:
+            restored, step = load_checkpoint(
+                directory, template=template_nt._asdict()
+            )
+            return state_cls(**restored), None, step
+        except Exception:
+            # the stored tree may predate newly-added EnvState fields
+            # (e.g. pending_forced, r4): raw-restore and rebuild with
+            # the documented backfills; a genuine mismatch still fails
+            # loudly inside _rebuild_like
+            raw, step = load_checkpoint(directory, template=None)
+            return _rebuild_like(template_nt, raw), None, step
     # params-only checkpoint (round-2 format / PBT best member)
     pfield = "params" if "params" in state_cls._fields else "learner_params"
     ptpl = jax.eval_shape(

@@ -52,6 +52,54 @@ def build_train_eval_envs(config: Dict[str, Any]) -> Tuple[Any, Optional[Any]]:
     return Environment(config), None
 
 
+def build_portfolio_train_eval_envs(config: Dict[str, Any]) -> Tuple[Any, Optional[Any]]:
+    """(train_env, eval_env-or-None) for the multi-pair portfolio env.
+
+    ``eval_portfolio_files``  evaluate on a separate per-pair file map;
+    ``eval_split``            hold out the LAST fraction of the ALIGNED
+                              bars (chronological, applied after the
+                              cross-pair timestamp join so no pair
+                              leaks future bars into training).
+    ``eval_data_file`` is rejected loudly: a single file cannot describe
+    a multi-pair book.
+    """
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+
+    if config.get("eval_data_file"):
+        raise ValueError(
+            "portfolio trainers hold out via eval_split or "
+            "eval_portfolio_files (a per-pair file map); eval_data_file "
+            "is single-pair only"
+        )
+    eval_files = config.get("eval_portfolio_files")
+    split = config.get("eval_split")
+    if eval_files and split:
+        raise ValueError("set either eval_portfolio_files or eval_split, not both")
+    if eval_files:
+        eval_config = dict(config)
+        eval_config["portfolio_files"] = dict(eval_files)
+        eval_config.pop("eval_portfolio_files", None)
+        train_env = PortfolioEnvironment(config)
+        eval_env = PortfolioEnvironment(eval_config)
+        # the policy's per-pair heads/obs channels are POSITIONAL: a
+        # different pair set or ordering would silently evaluate the
+        # wrong instruments on the wrong heads
+        if list(eval_env.pairs) != list(train_env.pairs):
+            raise ValueError(
+                "eval_portfolio_files must list the same pairs in the "
+                f"same order as portfolio_files (train {train_env.pairs}, "
+                f"eval {eval_env.pairs})"
+            )
+        return train_env, eval_env
+    if split:
+        frac = float(split)
+        return (
+            PortfolioEnvironment(config, split=("train", frac)),
+            PortfolioEnvironment(config, split=("eval", frac)),
+        )
+    return PortfolioEnvironment(config), None
+
+
 def labeled_eval_summary(make_summary, train_env, eval_env) -> Dict[str, Any]:
     """One definition of the out-of-sample summary shape for every
     trainer: ``make_summary(env_or_None)`` runs a greedy evaluation on

@@ -14,9 +14,13 @@ adjusted performance: total_return - lambda * drawdown_fraction, the
 reference's `rap`), keep the top half, refill with Gaussian mutations
 of elites clipped to the schema bounds.
 
-Note: ``atr_period`` from the reference schema sizes a ring buffer
-(static shape) and therefore cannot vary inside one compiled program;
-sweep it across separate optimize() calls if needed.
+``atr_period`` from the reference schema sizes a ring buffer (static
+shape) and therefore cannot vary inside one compiled program; it is
+covered by an OUTER sweep instead: ``optimize_from_config`` re-jits the
+batched GA once per period over a small grid (``optimize_atr_periods``,
+defaulting to points spanning the reference's 7..30 range) and selects
+the best (k_sl, k_tp, atr_period) triple by fitness — the full schema
+of reference strategy_plugins/direct_atr_sltp.py:345-350.
 """
 from __future__ import annotations
 
@@ -167,25 +171,103 @@ class Optimizer:
         }
 
 
+def atr_period_grid(config: Dict[str, Any]) -> List[int]:
+    """The outer-sweep grid for ``atr_period``.  Explicit
+    ``optimize_atr_periods`` wins; otherwise the ATR strategy gets a
+    default grid spanning the reference schema's 7..30 int range
+    (strategy_plugins/direct_atr_sltp.py:346) UNLESS the user pinned
+    ``atr_period`` in the config; non-ATR strategies never sweep."""
+    raw = config.get("optimize_atr_periods")
+    if isinstance(raw, str):  # CLI unknown-arg path delivers a JSON string
+        import json
+
+        try:
+            raw = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                "optimize_atr_periods must be a JSON list (e.g. "
+                f"'[7, 14, 21]') or a single integer, got {raw!r}"
+            ) from e
+    if isinstance(raw, (int, float)):  # scalar: a one-point grid
+        raw = [raw]
+    if raw:
+        return sorted({int(p) for p in raw})
+    if (
+        str(config.get("strategy_plugin", "")) == "direct_atr_sltp"
+        and config.get("atr_period") is None
+    ):
+        return [7, 14, 21, 30]
+    return []
+
+
 def optimize_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.train.common import reject_eval_keys
 
-    # honor-or-reject: GA fitness is evaluated in-sample on the full
-    # dataset; accepting the out-of-sample keys silently would sell
-    # contaminated numbers as held-out
+    # honor-or-reject: GA fitness is DEFINED on the training bars (the
+    # reference's external optimizer likewise scores candidates on the
+    # episode it runs); accepting the out-of-sample keys silently would
+    # sell contaminated numbers as held-out, so they are rejected loudly
+    # and the summary labels its scope explicitly
     reject_eval_keys(config, "optimization")
-    env = Environment(config)
-    optimizer = Optimizer(
-        env,
-        hparam_schema(config),
-        population=int(config.get("optimize_population", 32)),
-        risk_lambda=float(
-            config.get("risk_lambda", config.get("risk_penalty_lambda", 1.0))
-        ),
-        mutation_scale=float(config.get("optimize_mutation_scale", 0.15)),
-        episode_steps=config.get("steps"),
+
+    def run_at(period: Optional[int]) -> Dict[str, Any]:
+        cfg = dict(config)
+        if period is not None:
+            cfg["atr_period"] = int(period)
+        env = Environment(cfg)
+        optimizer = Optimizer(
+            env,
+            hparam_schema(cfg),
+            population=int(cfg.get("optimize_population", 32)),
+            risk_lambda=float(
+                cfg.get("risk_lambda", cfg.get("risk_penalty_lambda", 1.0))
+            ),
+            mutation_scale=float(cfg.get("optimize_mutation_scale", 0.15)),
+            episode_steps=cfg.get("steps"),
+        )
+        return optimizer.run(
+            generations=int(cfg.get("optimize_generations", 8)),
+            seed=int(cfg.get("seed", 0) or 0),
+        )
+
+    def label(result: Dict[str, Any]) -> Dict[str, Any]:
+        result["eval_scope"] = "in_sample_by_design"
+        result["eval_note"] = (
+            "GA fitness is defined on the training bars; eval_split/"
+            "eval_data_file are rejected (re-evaluate the best candidate "
+            "with driver_mode=policy or the training trainers for a "
+            "held-out number)"
+        )
+        return result
+
+    grid = atr_period_grid(config)
+    if not grid:
+        return label(run_at(None))
+
+    # outer sweep: one re-jitted batched GA per ring-buffer size, best
+    # triple selected by fitness (same identical-entry-stream seed per
+    # period, so periods compete on the hyperparameter, not on luck)
+    sweep, best_period, best = [], None, None
+    for period in grid:
+        res = run_at(period)
+        sweep.append(
+            {
+                "atr_period": period,
+                "best_rap": res["best_rap"],
+                "best_params": dict(res["best_params"]),
+            }
+        )
+        if best is None or res["best_rap"] > best["best_rap"]:
+            best_period, best = period, res
+
+    best["best_params"] = {**best["best_params"], "atr_period": best_period}
+    best["schema"].append(
+        {
+            "name": "atr_period",
+            "low": float(grid[0]),
+            "high": float(grid[-1]),
+            "grid": grid,
+        }
     )
-    return optimizer.run(
-        generations=int(config.get("optimize_generations", 8)),
-        seed=int(config.get("seed", 0) or 0),
-    )
+    best["atr_period_sweep"] = sweep
+    return label(best)

@@ -284,8 +284,9 @@ class PortfolioPPOTrainer:
         ratio = jnp.exp(logp - batch["logp"])
         adv = batch["adv"]
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        clip_eps, ent_coef = self._loss_hyper()
         unclipped = ratio * adv
-        clipped = jnp.clip(ratio, 1 - self.pcfg.clip_eps, 1 + self.pcfg.clip_eps) * adv
+        clipped = jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv
         policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
         value_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
         entropy = -jnp.mean(
@@ -293,10 +294,15 @@ class PortfolioPPOTrainer:
         )
         total = (
             policy_loss + self.pcfg.vf_coef * value_loss
-            - self.pcfg.ent_coef * entropy
+            - ent_coef * entropy
         )
         return total, dict(policy_loss=policy_loss, value_loss=value_loss,
                            entropy=entropy)
+
+    def _loss_hyper(self):
+        """(clip_eps, ent_coef) for the loss — static here; the PBT core
+        overrides with per-member traced values (train/pbt.py)."""
+        return self.pcfg.clip_eps, self.pcfg.ent_coef
 
     def _train_step_impl(self, state: PortfolioTrainState):
         pcfg = self.pcfg
@@ -369,11 +375,78 @@ class PortfolioPPOTrainer:
         return state, out
 
 
-def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
-    from gymfx_tpu.train.common import reject_eval_keys
+def evaluate(trainer: "PortfolioPPOTrainer", params,
+             steps: Optional[int] = None, chunk: int = 128) -> Dict[str, Any]:
+    """Greedy (per-pair argmax) portfolio episode -> reference-style
+    trading metrics on the ACCOUNT ledger, trade statistics pooled over
+    pairs.  Chunked scan (fixed-size jitted chunks) so long episodes
+    compile once — the portfolio twin of train/ppo.py evaluate."""
+    import math
+    import types
 
-    reject_eval_keys(config, "portfolio")
-    env = P.PortfolioEnvironment(config)
+    from gymfx_tpu.metrics import compute_analyzers, summarize_trading
+    from gymfx_tpu.train.ppo import _step_sharpe
+
+    env = trainer.env
+    cfg, eparams, data = env.cfg, env.params, env.data
+    steps = int(steps or cfg.n_bars - 1)
+    state0, obs0 = P.reset(cfg, eparams, data)
+    vec0 = trainer._encode(obs0)
+
+    @jax.jit
+    def run_chunk(params, st, vec):
+        def body(carry, _):
+            st, vec = carry
+            logits, _v = trainer._forward(params, vec)
+            action = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            st2, obs2, _r, done, info = P.step(cfg, eparams, data, st, action)
+            return (st2, trainer._encode(obs2)), (info["equity"], done)
+
+        (st, vec), outs = jax.lax.scan(body, (st, vec), None, length=chunk)
+        return st, vec, outs
+
+    state, vec = state0, vec0
+    eqs, dones = [], []
+    for _ in range(max(1, math.ceil(steps / chunk))):
+        state, vec, (eq, dn) = run_chunk(params, state, vec)
+        eqs.append(np.asarray(eq, np.float64))
+        dones.append(np.asarray(dn, bool))
+    equity = np.concatenate(eqs)[:steps]
+    done = np.concatenate(dones)[:steps]
+
+    pairs, acct = jax.device_get((state.pairs, state.acct))
+    agg = types.SimpleNamespace(
+        trade_count=int(np.sum(pairs.trade_count)),
+        trades_won=int(np.sum(pairs.trades_won)),
+        trades_lost=int(np.sum(pairs.trades_lost)),
+        trade_pnl_sum=float(np.sum(pairs.trade_pnl_sum)),
+        trade_pnl_sumsq=float(np.sum(pairs.trade_pnl_sumsq)),
+        max_drawdown_pct=float(acct.max_drawdown_pct),
+        max_drawdown_money=float(acct.max_drawdown_money),
+    )
+    ts = env.timestamps[1 : steps + 1]
+    analyzers = compute_analyzers(equity=equity, done=done, state=agg,
+                                  timestamps=ts)
+    final_eq = float(equity[int(np.argmax(done))] if done.any() else equity[-1])
+    summary = summarize_trading(
+        initial_cash=float(eparams.acct.initial_cash),
+        final_equity=final_eq,
+        analyzers=analyzers,
+        config=env.config,
+    )
+    tf_hours = env.timeframe_hours or (1.0 / 60.0)
+    summary["sharpe_ratio_steps"] = _step_sharpe(equity, tf_hours)
+    summary["pairs"] = list(env.pairs)
+    return summary
+
+
+def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    from gymfx_tpu.train.common import (
+        build_portfolio_train_eval_envs,
+        labeled_eval_summary,
+    )
+
+    env, eval_env = build_portfolio_train_eval_envs(config)
     pcfg = PortfolioPPOConfig(
         n_envs=int(config.get("num_envs", 64) or 64),
         horizon=int(config.get("ppo_horizon", 64)),
@@ -391,8 +464,17 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         int(config.get("train_total_steps", 1_000_000)),
         seed=int(config.get("seed", 0) or 0),
     )
-    summary = {"mode": "training", "trainer": "portfolio_ppo",
-               "pairs": env.pairs, "train_metrics": metrics}
+    # held-out evaluation (VERDICT r4 item #3): greedy episode on the
+    # aligned bars the agent never trained on, in-sample riding along
+    summary = labeled_eval_summary(
+        lambda e: evaluate(
+            trainer if e is None else PortfolioPPOTrainer(e, pcfg),
+            state.params,
+        ),
+        env, eval_env,
+    )
+    summary.update({"mode": "training", "trainer": "portfolio_ppo",
+                    "pairs": env.pairs, "train_metrics": metrics})
     if mesh is not None:
         summary["mesh_shape"] = dict(mesh.shape)
     ckpt_dir = config.get("checkpoint_dir")

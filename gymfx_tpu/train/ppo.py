@@ -323,18 +323,26 @@ class PPOTrainer:
         ratio = jnp.exp(logp - batch["logp"])
         adv = batch["adv"]
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        clip_eps, ent_coef = self._loss_hyper()
         unclipped = ratio * adv
-        clipped = jnp.clip(ratio, 1 - self.pcfg.clip_eps, 1 + self.pcfg.clip_eps) * adv
+        clipped = jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv
         policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
         value_loss = 0.5 * jnp.mean((value - batch["ret"]) ** 2)
         total = (
             policy_loss
             + self.pcfg.vf_coef * value_loss
-            - self.pcfg.ent_coef * entropy
+            - ent_coef * entropy
         )
         return total, dict(
             policy_loss=policy_loss, value_loss=value_loss, entropy=entropy
         )
+
+    def _loss_hyper(self):
+        """(clip_eps, ent_coef) used by the loss — static config values
+        here; the PBT cores override them with per-member TRACED values
+        read from opt_state.hyperparams so a vmapped population explores
+        them independently (train/pbt.py)."""
+        return self.pcfg.clip_eps, self.pcfg.ent_coef
 
     def _train_step_impl(self, state: TrainState):
         pcfg = self.pcfg

@@ -269,6 +269,108 @@ def test_replay_closeout_cancels_inflight_orders_with_event():
     assert result["summary"]["positions_open"] == 0
 
 
+def test_forced_liquidation_bypasses_min_quantity():
+    """A maintenance-closeout order fills even when the stranded position
+    is below min_quantity / off the size grid — the replay venue's
+    liquidation bypass ('a venue never strands a liquidation on a size
+    rule', simulation/replay.py check_margin_closeout).  An identical
+    agent-made flat order is still denied."""
+    import jax.numpy as jnp
+
+    from gymfx_tpu.core import broker
+
+    env = make_env(make_df(CLOSES), **MARGIN_CONFIG)
+    params = env.params._replace(
+        min_qty=jnp.asarray(1.0, jnp.float32),
+        size_step=jnp.asarray(1.0, jnp.float32),
+    )
+    st = env.reset()[0]._replace(
+        pos=jnp.asarray(0.4, jnp.float32),
+        entry_price=jnp.asarray(1.0, jnp.float32),
+        pending_active=jnp.asarray(True),
+        pending_target=jnp.asarray(0.0, jnp.float32),
+    )
+    one = jnp.asarray(1.0, jnp.float32)
+    # agent-made flat order below min_qty: denied, position stranded
+    denied = broker.fill_pending(st, one, params)
+    assert float(denied.pos) == pytest.approx(0.4)
+    assert int(denied.exec_diag[EXEC_DIAG_INDEX["order_denied_min_quantity"]]) == 1
+    # venue-forced liquidation: bypasses the size rules, fills exactly flat
+    forced = broker.fill_pending(
+        st._replace(pending_forced=jnp.asarray(True)), one, params
+    )
+    assert float(forced.pos) == 0.0
+    assert int(forced.exec_diag[EXEC_DIAG_INDEX["order_denied_min_quantity"]]) == 0
+    assert not bool(forced.pending_forced)  # flag cleared with the fill
+
+
+def test_scan_closeout_fills_despite_min_quantity_in_episode():
+    """End-to-end: with the open position below the venue's min_quantity
+    (tightened after entry), the maintenance breach still liquidates —
+    the forced order carries the bypass flag through the step kernel.
+    Without the bypass the closeout would be denied and re-triggered
+    every bar (unboundedly incrementing margin_closeouts)."""
+    import jax.numpy as jnp
+
+    env = make_env(make_df(CLOSES), **MARGIN_CONFIG)
+    state, obs = env.reset()
+    state, *_ = env.step(state, 1)   # warmup: entry submitted
+    state, *_ = env.step(state, 0)   # fills 100k at the next open
+    assert float(state.pos) == 100_000.0
+    # venue tightens min_qty above the open position (params-only change,
+    # no recompile): any agent-made exit would now be denied
+    strict = env.params._replace(min_qty=jnp.asarray(200_000.0, jnp.float32))
+    last = None
+    for _ in range(len(CLOSES) - 3):
+        state, obs, r, done, info = env.step(state, 0, params=strict)
+        last = state
+    assert int(last.exec_diag[EXEC_DIAG_INDEX["margin_closeouts"]]) == 1
+    assert float(last.pos) == 0.0  # liquidation was NOT stranded
+    assert int(last.exec_diag[EXEC_DIAG_INDEX["order_denied_min_quantity"]]) == 0
+
+
+def test_replay_terminal_bar_breach_parity_with_scan():
+    """Event/diag parity at a final-bar breach (ADVICE r3, rebutted): the
+    scan engine COUNTS a breach detected at the final bar close (its
+    advance gate only suppresses the exhausted re-visit), leaving the
+    forced order pending forever; the replay twin emits exactly one
+    margin_closeout event and leaves its forced order
+    pending-unexecuted — the same observable outcome, so the closeout
+    check deliberately runs on the final frame too."""
+    from gymfx_tpu.contracts import InstrumentSpec, MarketFrame, TargetAction
+    from gymfx_tpu.simulation.replay import ReplayAdapter
+
+    closes = [1.0] * 6 + [0.9880]  # crash on the final bar only
+    spec = InstrumentSpec(
+        symbol="EUR/USD", venue="SIM", base_currency="EUR",
+        quote_currency="USD", price_precision=5, size_precision=0,
+        margin_init=0.05, margin_maint=0.025, min_quantity=1.0,
+    )
+    frames = [
+        MarketFrame(
+            instrument_id=spec.instrument_id, timeframe_minutes=1,
+            ts_event_ns=i * 60_000_000_000, open=c, high=c, low=c, close=c,
+            volume=0.0,
+        )
+        for i, c in enumerate(closes)
+    ]
+    actions = [TargetAction(spec.instrument_id, 0, 100_000.0, "enter-long")]
+    result = ReplayAdapter(_replay_profile()).run(
+        instrument_specs=[spec], frames=frames, actions=actions,
+        initial_cash=1000.0, base_currency="USD", default_leverage=20.0,
+    )
+    events = result["events"]
+    closeouts = [e for e in events if e["event_type"] == "margin_closeout"]
+    assert len(closeouts) == 1  # scan's diag == 1 at the same bar
+    forced_fills = [
+        e for e in events
+        if e["event_type"] == "order_filled" and e["action_id"] == "margin-closeout"
+    ]
+    assert forced_fills == []  # no next frame: the forced order never fills
+    assert result["native"]["orders_pending_unexecuted"] == 1
+    assert result["summary"]["positions_open"] == 1  # scan's pos stays open too
+
+
 def test_closeout_disabled_leaves_position_open():
     config = dict(MARGIN_CONFIG)
     config["enforce_margin_closeout"] = False  # explicit override

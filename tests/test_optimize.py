@@ -74,7 +74,52 @@ def test_cli_optimization_mode(tmp_path):
         "--strategy_plugin", "direct_atr_sltp",
         "--steps", "80", "--quiet_mode",
         "--optimize_population", "6", "--optimize_generations", "2",
+        "--optimize_atr_periods", "[5, 10]",
         "--results_file", str(tmp_path / "opt.json"),
     ])
     assert s["mode"] == "optimization"
+    # the full reference schema (k_sl, k_tp, atr_period) is covered
     assert "best_params" in s and "k_sl" in s["best_params"]
+    assert s["best_params"]["atr_period"] in (5, 10)
+    assert len(s["atr_period_sweep"]) == 2
+
+
+def test_atr_period_grid_rules():
+    from gymfx_tpu.train.optimize import atr_period_grid
+
+    # explicit grid wins (and dedupes/sorts)
+    assert atr_period_grid({"optimize_atr_periods": [21, 7, 7]}) == [7, 21]
+    # ATR strategy without a pinned period: default reference-range grid
+    assert atr_period_grid({"strategy_plugin": "direct_atr_sltp"}) == [7, 14, 21, 30]
+    # user pinned atr_period -> honored, no sweep
+    assert atr_period_grid(
+        {"strategy_plugin": "direct_atr_sltp", "atr_period": 9}
+    ) == []
+    # non-ATR strategies never sweep
+    assert atr_period_grid({"strategy_plugin": "default_strategy"}) == []
+
+
+def test_atr_period_sweep_selects_best_by_fitness():
+    from gymfx_tpu.train.optimize import optimize_from_config
+
+    df = _noisy_df()
+    path = "/tmp/optimize_sweep_data.csv"
+    df.reset_index().to_csv(path, index=False)
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        input_data_file=path, window_size=8, timeframe="M1",
+        strategy_plugin="direct_atr_sltp", position_size=2000.0,
+        optimize_population=6, optimize_generations=2, steps=100,
+        optimize_atr_periods=[5, 12],
+    )
+    config.pop("atr_period", None)
+    result = optimize_from_config(config)
+    assert result["best_params"]["atr_period"] in (5, 12)
+    assert {s["atr_period"] for s in result["atr_period_sweep"]} == {5, 12}
+    # the winner is the sweep's max-fitness row
+    winner = max(result["atr_period_sweep"], key=lambda s: s["best_rap"])
+    assert result["best_params"]["atr_period"] == winner["atr_period"]
+    assert result["best_rap"] == pytest.approx(winner["best_rap"])
+    # schema advertises the swept dimension like the reference's
+    assert any(e.get("name") == "atr_period" for e in result["schema"]
+               if isinstance(e, dict))

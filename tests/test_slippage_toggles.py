@@ -1,0 +1,163 @@
+"""Per-fill-type slippage switches (VERDICT r4 item #7): the scan twins
+of the reference broker's backtrader configuration
+``set_slippage_perc(perc, slip_open, slip_limit, slip_match)``
+(reference broker_plugins/default_broker.py:52).  Defaults preserve the
+kernel's historical behavior bit-for-bit (DIVERGENCES.md #5)."""
+import numpy as np
+import pytest
+
+from tests.helpers import make_df, make_env
+
+SLIP = 0.01
+
+
+def test_default_flags_match_reference_defaults_off():
+    env = make_env(make_df([1.0] * 8))
+    assert env.cfg.slip_open is True
+    assert env.cfg.slip_limit is False
+    assert env.cfg.slip_match is False
+
+
+def _entry_price_after_long(env):
+    state, obs = env.reset()
+    state, *_ = env.step(state, 1)   # warmup: entry submitted
+    state, *_ = env.step(state, 0)   # fills at the next bar's open
+    assert float(state.pos) > 0
+    return float(state.entry_price)
+
+
+def test_slip_open_off_fills_market_orders_at_the_open_exactly():
+    closes = [1.0] * 8
+    base = dict(slippage_perc=SLIP, position_size=1000.0)
+    slipped = _entry_price_after_long(make_env(make_df(closes), **base))
+    exact = _entry_price_after_long(
+        make_env(make_df(closes), slip_open=False, **base)
+    )
+    assert slipped == pytest.approx(1.0 * (1.0 + SLIP))
+    assert exact == pytest.approx(1.0, abs=1e-9)
+
+
+def test_slip_limit_applies_capped_slippage_to_gap_tp_fills():
+    """Long TP at 1.02; the bar gaps open at 1.05 (cross policy fills at
+    the open).  slip_limit off: fill at 1.05 exactly (historical).
+    slip_limit on: the sell fill slips adversely to 1.05*(1-slip),
+    still above the limit, so the cap does not bind."""
+    opens = [1.00] * 3 + [1.05] * 5
+    highs = [1.00] * 3 + [1.06] * 5
+    lows = [1.00] * 3 + [1.04] * 5
+    closes = [1.00] * 3 + [1.05] * 5
+    base = dict(
+        slippage_perc=SLIP,
+        position_size=1000.0,
+        strategy_plugin="direct_fixed_sltp",
+        sl_pips=500.0,          # SL at 0.95: never touched
+        tp_pips=200.0,          # TP at 1.02
+        pip_size=0.0001,
+        limit_fill_policy="cross",
+    )
+
+    def run(**over):
+        env = make_env(
+            make_df(closes, opens=opens, highs=highs, lows=lows),
+            **{**base, **over},
+        )
+        state, obs = env.reset()
+        state, *_ = env.step(state, 1)       # entry submitted on bar 0
+        last = None
+        for _ in range(5):
+            state, obs, r, done, info = env.step(state, 0)
+            last = state
+        assert float(last.pos) == 0.0        # TP exited
+        # one entry+exit trade: recover the exit price from realized pnl
+        # pnl = (exit - entry) * units - commissions(0)
+        entry = 1.0 * (1.0 + SLIP)
+        return entry + float(last.trade_pnl_sum) / 1000.0
+
+    exit_off = run()
+    exit_on = run(slip_limit=True)
+    assert exit_off == pytest.approx(1.05, rel=1e-6)
+    assert exit_on == pytest.approx(1.05 * (1.0 - SLIP), rel=1e-6)
+    assert exit_on >= 1.02  # the limit cap held
+
+
+def test_slip_limit_cap_binds_at_the_limit_price():
+    """A TP touch fill (no gap) with slip_limit on still fills at the
+    limit exactly: the adverse slip would take it below the limit and
+    the cap clamps it back."""
+    opens = [1.00] * 8
+    highs = [1.00] * 3 + [1.03] * 5
+    lows = [1.00] * 8
+    closes = [1.00] * 3 + [1.01] * 5
+    env = make_env(
+        make_df(closes, opens=opens, highs=highs, lows=lows),
+        slippage_perc=SLIP,
+        position_size=1000.0,
+        strategy_plugin="direct_fixed_sltp",
+        sl_pips=500.0,
+        tp_pips=200.0,           # TP 1.02, touched by high 1.03
+        pip_size=0.0001,
+        slip_limit=True,
+    )
+    state, obs = env.reset()
+    state, *_ = env.step(state, 1)
+    last = None
+    for _ in range(5):
+        state, obs, r, done, info = env.step(state, 0)
+        last = state
+    assert float(last.pos) == 0.0
+    entry = 1.0 * (1.0 + SLIP)
+    exit_price = entry + float(last.trade_pnl_sum) / 1000.0
+    assert exit_price == pytest.approx(1.02, rel=1e-6)
+
+
+def test_slip_match_caps_sl_fill_into_the_bar_range():
+    """Long SL at 1.00 triggers intrabar; adverse slip would fill at
+    1.00*(1-0.01)=0.99, below the bar's low of 0.995 — slip_match caps
+    the fill at the low."""
+    opens = [1.01] * 3 + [1.005] * 5
+    highs = [1.01] * 3 + [1.005] * 5
+    lows = [1.01] * 3 + [0.995] * 5
+    closes = [1.01] * 3 + [1.0] * 5
+    base = dict(
+        slippage_perc=SLIP,
+        position_size=1000.0,
+        strategy_plugin="direct_fixed_sltp",
+        sl_pips=100.0,           # SL at entry(1.01... pre-slip close) - 0.01
+        tp_pips=900.0,           # TP far away
+        pip_size=0.0001,
+    )
+
+    def run(entry, **over):
+        env = make_env(
+            make_df(closes, opens=opens, highs=highs, lows=lows),
+            **{**base, **over},
+        )
+        state, obs = env.reset()
+        state, *_ = env.step(state, 1)   # SL armed at close(1.01) - 100 pips = 1.00
+        last, seen_entry = None, None
+        for _ in range(5):
+            state, obs, r, done, info = env.step(state, 0)
+            if float(state.pos) > 0:
+                seen_entry = float(state.entry_price)
+            last = state
+        assert float(last.pos) == 0.0    # stopped out
+        assert seen_entry == pytest.approx(entry, rel=1e-6)
+        return entry + float(last.trade_pnl_sum) / 1000.0
+
+    # slip_match also caps the ENTRY fill: the degenerate entry bar
+    # (O=H=L=C=1.01) suppresses its slippage entirely (backtrader's
+    # slip_match caps market fills at the bar's high/low too)
+    uncapped = run(entry=1.01 * (1.0 + SLIP))
+    capped = run(entry=1.01, slip_match=True)
+    assert uncapped == pytest.approx(1.00 * (1.0 - SLIP), rel=1e-6)
+    assert capped == pytest.approx(0.995, rel=1e-6)
+
+
+def test_crosscheck_refuses_non_default_switches():
+    from gymfx_tpu.simulation.crosscheck import crosscheck_episode
+
+    env = make_env(
+        make_df([1.0] * 12), slippage_perc=SLIP, slip_limit=True
+    )
+    with pytest.raises(ValueError, match="slip_open/slip_limit/slip_match"):
+        crosscheck_episode(dict(env.config), actions=[1, 0, 0], env=env)
