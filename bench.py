@@ -91,19 +91,25 @@ def main() -> None:
     env = Environment(config)
     trainer = PPOTrainer(env, ppo_config_from(config))
 
+    from gymfx_tpu.bench_util import compile_with_flops, mfu
+
     state = trainer.init_state(0)
-    state, _ = trainer.train_step(state)  # compile + warmup
+    # ONE compilation serves cost analysis and execution
+    compiled, step_flops = compile_with_flops(trainer._train_step, state)
+    step = compiled if compiled is not None else trainer.train_step
+    state, _ = step(state)  # warmup
     jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
     for _ in range(args.iters):
-        state, metrics = trainer.train_step(state)
+        state, metrics = step(state)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
     env_steps = args.n_envs * args.horizon * args.iters
     steps_per_sec = env_steps / dt
     baseline_per_chip = 1_000_000 / 8  # BASELINE.json: 1M steps/s on v5p-8
+    util = mfu(step_flops, args.iters, dt, jax.devices()[0])
     print(
         json.dumps(
             {
@@ -111,6 +117,9 @@ def main() -> None:
                 "value": round(steps_per_sec, 1),
                 "unit": "env steps/sec/chip (PPO MLP bf16 policy, fused rollout+update)",
                 "vs_baseline": round(steps_per_sec / baseline_per_chip, 3),
+                # XLA cost-model FLOPs / public peak bf16 chip FLOPs
+                # (gymfx_tpu/bench_util.py); null off-TPU
+                "mfu": round(util, 5) if util is not None else None,
             }
         )
     )

@@ -306,10 +306,14 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
         # done fires on dataset exhaustion as well as bankruptcy
         # (core/env.py termination); only bankruptcy invalidates the
         # cross-check — an exhausted episode is a complete action
-        # stream.  Distinguish by the bar cursor (exact in any compute
-        # dtype): exhaustion means the cursor reached the final bar.
-        final_t = int(np.asarray(jax.device_get(state.t)))
-        bankrupt = bool(done.any()) and final_t < env.n_bars - 1
+        # stream.  The env records the reason explicitly (a bankruptcy
+        # ON the final bar would fool any bar-cursor heuristic).
+        from gymfx_tpu.core.types import TERMINATION_BANKRUPT
+
+        bankrupt = (
+            int(np.asarray(jax.device_get(state.termination_reason)))
+            == TERMINATION_BANKRUPT
+        )
         try:
             summary["execution_crosscheck"] = crosscheck_episode(
                 config,

@@ -1,0 +1,78 @@
+"""Benchmark helpers: XLA-counted FLOPs and model FLOPs utilization.
+
+MFU here is defined against the XLA cost model of the FULL compiled
+train step (policy matmuls + optimizer + env arithmetic — the env's
+elementwise math is a rounding error next to the policy GEMMs), divided
+by the chip's public peak dense-bf16 throughput.  That makes it an
+end-to-end hardware-utilization number for the fused
+rollout+update program, reproducible from the compiled executable
+alone (no hand-counted FLOP formulas to drift out of date).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# Public per-chip peak dense bf16 FLOPs/sec (vendor-published specs).
+PEAK_BF16_FLOPS = {
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+    "trillium": 918e12,
+    "v5p": 459e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v4": 275e12,
+}
+
+
+def device_peak_flops(device: Any) -> Optional[float]:
+    """Peak dense-bf16 FLOPs/sec of ``device``, or None when unknown
+    (CPU, or a TPU generation missing from the table)."""
+    kind = str(getattr(device, "device_kind", "")).lower()
+    if not kind:
+        return None
+    for key in sorted(PEAK_BF16_FLOPS, key=len, reverse=True):
+        if key in kind:
+            return PEAK_BF16_FLOPS[key]
+    return None
+
+
+def compile_with_flops(jitted_fn: Any, *args: Any):
+    """AOT-compile ``jitted_fn`` for ``args`` ONCE and read the XLA cost
+    analysis off the same executable: ``(compiled_or_None,
+    flops_or_None)``.  Benchmarks execute the returned executable
+    directly, so the program is never compiled a second time through the
+    jit dispatch cache."""
+    try:
+        compiled = jitted_fn.lower(*args).compile()
+    except Exception:
+        return None, None
+    flops = None
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if analysis:
+            raw = analysis.get("flops")
+            if raw and raw > 0:
+                flops = float(raw)
+    except Exception:
+        pass
+    return compiled, flops
+
+
+def compiled_step_flops(jitted_fn: Any, *args: Any) -> Optional[float]:
+    """FLOPs of one invocation per the XLA cost analysis; None when the
+    backend does not expose it (compiles as a side effect — benchmarks
+    should use :func:`compile_with_flops` and keep the executable)."""
+    return compile_with_flops(jitted_fn, *args)[1]
+
+
+def mfu(flops_per_iter: Optional[float], iters: int, seconds: float,
+        device: Any) -> Optional[float]:
+    """Achieved / peak FLOPs fraction, or None when either side is
+    unknown."""
+    peak = device_peak_flops(device)
+    if not (flops_per_iter and peak and seconds > 0):
+        return None
+    return (flops_per_iter * iters / seconds) / peak

@@ -246,7 +246,22 @@ def step(
     equity = params.initial_cash + st.equity_delta
     broke = equity <= params.min_equity
     terminated = was_terminated | exhausted | (live & broke)
-    st = st._replace(terminated=terminated)
+    # explicit reason, latched at FIRST termination: bankruptcy wins over
+    # exhaustion (a final-bar bankruptcy is a bankruptcy — the bar cursor
+    # alone cannot tell them apart, types.py TERMINATION_*)
+    from gymfx_tpu.core.types import TERMINATION_BANKRUPT, TERMINATION_EXHAUSTED
+
+    reason_now = jnp.where(
+        live & broke,
+        jnp.int32(TERMINATION_BANKRUPT),
+        jnp.where(exhausted, jnp.int32(TERMINATION_EXHAUSTED), jnp.int32(0)),
+    )
+    st = st._replace(
+        terminated=terminated,
+        termination_reason=jnp.where(
+            was_terminated, st.termination_reason, reason_now
+        ).astype(jnp.int32),
+    )
 
     obs = build_obs(st, data, cfg, params)
     info = build_info(st, data, cfg, params, event_info)
@@ -267,6 +282,7 @@ def step(
     info["bracket_sl"] = st.bracket_sl
     info["bracket_tp"] = st.bracket_tp
     info["position_units"] = st.pos
+    info["termination_reason"] = st.termination_reason
     info["atr"] = jnp.where(
         st.tr_len > 0,
         jnp.sum(st.tr_buffer) / jnp.maximum(st.tr_len, 1).astype(st.tr_buffer.dtype),

@@ -30,8 +30,13 @@ realized pnl "parked" in a foreign quote currency floats with FX until
 the episode ends — how a real multi-currency margin account behaves
 before sweeps.  The replay engine (like Nautilus) converts realized pnl
 at fill time; the difference is conversion drift on already-realized
-pnl, covered by the bake-off tolerance at fixture scale (see
-DIVERGENCES.md).
+pnl.  ``sweep_realized_pnl: true`` switches the account to the
+replay/fill-time semantics: each bar's realized increment is banked in
+the account currency at that bar's rate, bounding the residual to one
+bar's FX move on the increment (tests/test_portfolio.py drift tests);
+the default keeps the float-with-FX behavior the oracle reconciles,
+whose drift is exactly sum(realized_q * (conv_now - conv_then)) — see
+DIVERGENCES.md.
 
 Static-policy constraint: per-pair profiles may differ in every numeric
 field (commission, spread, slippage, margin), but fields that select
@@ -103,6 +108,14 @@ class PortfolioConfig:
     enforce_margin_preflight: bool = False
     enforce_margin_closeout: bool = False
     margin_model: str = "leveraged"
+    # opt-in (``sweep_realized_pnl``): convert each bar's REALIZED pnl
+    # increment to the account currency at that bar's rate and bank it,
+    # instead of letting realized pnl float in the quote currency until
+    # episode end — the replay/Nautilus fill-time conversion semantics
+    # (bounded residual: one bar's FX move on the increment, vs the
+    # whole episode's move on the balance).  Default off = the
+    # real-margin-account behavior the oracle reconciles.
+    sweep_realized_pnl: bool = False
     dtype: Any = jnp.float32
 
 
@@ -114,6 +127,11 @@ class PortfolioParams(NamedTuple):
 class PortfolioState(NamedTuple):
     pairs: EnvState        # every leaf with a leading (I,) axis
     acct: EnvState         # scalar account-level carry
+    # realized-pnl sweep carries (used when cfg.sweep_realized_pnl; zero
+    # otherwise): account-currency bank of swept realized pnl, and each
+    # pair's last-seen realized balance delta (quote currency)
+    swept_realized: Any = 0.0      # scalar, account currency
+    prev_realized_q: Any = 0.0     # (I,) quote currency
 
 
 # ---------------------------------------------------------------------------
@@ -193,7 +211,11 @@ def reset(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData):
         prev_equity_delta=eq,
         peak_equity_delta=jnp.maximum(acct.peak_equity_delta, eq),
     )
-    state = PortfolioState(pairs=pairs, acct=acct)
+    state = PortfolioState(
+        pairs=pairs, acct=acct,
+        swept_realized=jnp.zeros((), cfg.dtype),
+        prev_realized_q=jnp.zeros((cfg.n_pairs,), cfg.dtype),
+    )
     return state, _portfolio_obs(obs_i, state, data, cfg, params)
 
 
@@ -230,9 +252,21 @@ def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
         if cfg.margin_model == "leveraged":
             required_q = required_q / jnp.maximum(params.pair.leverage, 1e-12)
         required = required_q * conv               # account currency
-        free = params.acct.initial_cash + jnp.sum(
-            conv * (pairs.cash_delta + pairs.pos * pairs.entry_price)
-        )
+        if cfg.sweep_realized_pnl:
+            # fill-time-conversion mode: free balance = banked realized
+            # pnl (historic rates) + this bar's unbanked increment at the
+            # current rate — the same measure the equity mark below uses,
+            # so margin granted never diverges from the account's equity
+            realized_q = pairs.cash_delta + pairs.pos * pairs.entry_price
+            free = (
+                params.acct.initial_cash
+                + state.swept_realized
+                + jnp.sum(conv * (realized_q - state.prev_realized_q))
+            )
+        else:
+            free = params.acct.initial_cash + jnp.sum(
+                conv * (pairs.cash_delta + pairs.pos * pairs.entry_price)
+            )
         want = pairs.pending_active & (opening > 0)
 
         def grant_body(granted_sum, req_want):
@@ -261,7 +295,30 @@ def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
     exhausted = live & acct.started & (acct.t >= n - 1)
     marking = advance | (live & ~acct.started)
 
-    eq = jnp.sum(conv * pairs.equity_delta).astype(acct.equity_delta.dtype)
+    if cfg.sweep_realized_pnl:
+        # fill-time conversion semantics (replay/Nautilus): each bar's
+        # realized increment is banked at THAT bar's rate; only the
+        # unrealized leg floats with FX.  realized_q = cash + pos*entry
+        # (the position's entry notional cancels the open cash outlay),
+        # unrealized_q = pos * (close - entry).
+        realized_q = (pairs.cash_delta + pairs.pos * pairs.entry_price).astype(
+            state.prev_realized_q.dtype
+        )
+        unrealized_q = pairs.equity_delta - realized_q
+        swept = state.swept_realized + jnp.sum(
+            conv * (realized_q - state.prev_realized_q)
+        ).astype(state.swept_realized.dtype)
+        swept = jnp.where(marking, swept, state.swept_realized)
+        prev_realized_q = jnp.where(
+            marking, realized_q, state.prev_realized_q
+        )
+        eq = (swept + jnp.sum(conv * unrealized_q)).astype(
+            acct.equity_delta.dtype
+        )
+    else:
+        swept = state.swept_realized
+        prev_realized_q = state.prev_realized_q
+        eq = jnp.sum(conv * pairs.equity_delta).astype(acct.equity_delta.dtype)
     acct = acct._replace(
         t=t_new,
         started=acct.started | live,
@@ -320,10 +377,25 @@ def step(cfg: PortfolioConfig, params: PortfolioParams, data: PortfolioData,
     equity = params.acct.initial_cash + acct.equity_delta
     broke = equity <= params.acct.min_equity
     terminated = was_terminated | exhausted | (live & broke)
-    acct = acct._replace(terminated=terminated)
+    from gymfx_tpu.core.types import TERMINATION_BANKRUPT, TERMINATION_EXHAUSTED
+
+    reason_now = jnp.where(
+        live & broke,
+        jnp.int32(TERMINATION_BANKRUPT),
+        jnp.where(exhausted, jnp.int32(TERMINATION_EXHAUSTED), jnp.int32(0)),
+    )
+    acct = acct._replace(
+        terminated=terminated,
+        termination_reason=jnp.where(
+            was_terminated, acct.termination_reason, reason_now
+        ).astype(jnp.int32),
+    )
     pairs = pairs._replace(terminated=pairs.terminated | terminated)
 
-    new_state = PortfolioState(pairs=pairs, acct=acct)
+    new_state = PortfolioState(
+        pairs=pairs, acct=acct,
+        swept_realized=swept, prev_realized_q=prev_realized_q,
+    )
     obs = _portfolio_obs(obs_i, new_state, data, cfg, params)
     info = _portfolio_info(info_i, new_state, conv, cfg, params)
     info["reward"] = reward
@@ -528,6 +600,7 @@ class PortfolioEnvironment:
             enforce_margin_preflight=enforce,
             enforce_margin_closeout=enforce_closeout,
             margin_model=cfg0.margin_model,
+            sweep_realized_pnl=bool(config.get("sweep_realized_pnl", False)),
             dtype=cfg0.dtype,
         )
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from gymfx_tpu.core import env as env_core
-from gymfx_tpu.core.types import EnvConfig, EnvParams, EnvState
+from gymfx_tpu.core.types import EXEC_DIAG_INDEX, EnvConfig, EnvParams, EnvState
 from gymfx_tpu.data.feed import MarketData
 
 
@@ -127,6 +127,16 @@ def _make_scan_body(cfg, params, data, driver, collect, offset):
                 "pending_sl": state.pending_sl,
                 "pending_tp": state.pending_tp,
                 "pos_units": state.pos,
+                # the ACTUAL armed bracket levels and the venue-denial
+                # counter after this step: the crosscheck builds each
+                # bar's execution path from these instead of inferring
+                # them from order history (stale levels / denied fills
+                # would otherwise poison later bars' paths)
+                "bracket_sl": state.bracket_sl,
+                "bracket_tp": state.bracket_tp,
+                "order_denied": state.exec_diag[
+                    EXEC_DIAG_INDEX["order_denied_min_quantity"]
+                ],
             }
             if cfg.event_context_execution_overlay:
                 out["event_context"] = {

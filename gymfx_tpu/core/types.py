@@ -47,6 +47,15 @@ EXEC_DIAG_KEYS = (
 )
 EXEC_DIAG_INDEX = {k: i for i, k in enumerate(EXEC_DIAG_KEYS)}
 
+# EnvState.termination_reason codes (why `terminated` first became True;
+# 0 while running).  An explicit flag — the bar cursor cannot distinguish
+# a bankruptcy ON the final bar from ordinary exhaustion (r2 advisor
+# finding, fixed r4).
+TERMINATION_RUNNING = 0
+TERMINATION_BANKRUPT = 1
+TERMINATION_EXHAUSTED = 2
+TERMINATION_REASONS = ("running", "bankrupt", "exhausted")
+
 ACTION_DIAG_KEYS = (
     "steps",
     "hold_actions",
@@ -219,6 +228,7 @@ class EnvState(NamedTuple):
     t: Any                 # i32 current bar row (0-based); bar_index = t + 1
     started: Any           # bool — warmup handshake done (reference bt_bridge.py:144-151)
     terminated: Any        # bool
+    termination_reason: Any  # i32 TERMINATION_* code (0 while running)
 
     # broker ledger (all in quote currency, relative to initial cash)
     pos: Any               # signed units
@@ -553,6 +563,7 @@ def initial_state(cfg: EnvConfig) -> EnvState:
         t=zi,
         started=jnp.zeros((), dtype=bool),
         terminated=jnp.zeros((), dtype=bool),
+        termination_reason=zi,
         pos=z,
         entry_price=z,
         cash_delta=z,

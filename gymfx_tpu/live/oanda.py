@@ -1,0 +1,185 @@
+"""Live OANDA order routing over the v20 REST API.
+
+The reference's gated broker hands live trading to backtrader's
+``OandaStore`` (reference broker_plugins/oanda_broker.py:58-63).  This
+framework has no backtrader engine to hand anything to, so the live
+surface is built the framework's way instead: the strategy kernels
+already express every decision as a *pending target* (signed units +
+optional bracket prices — the decision stream the replay engine
+re-executes, simulation/crosscheck.py), and ``TargetOrderRouter`` maps
+exactly that stream onto OANDA order payloads.  One adapter serves
+every strategy kernel, like the crosscheck does.
+
+``OandaLiveBroker`` is a dependency-free v20 client (urllib; the image
+has no ``requests``).  The HTTP transport is injectable so the whole
+surface is testable offline — tests drive it with a fake transport and
+assert the exact payloads (tests/test_live_oanda.py); nothing here is
+imported by the simulation path.
+
+Endpoints used (OANDA v20 public API):
+  GET  /v3/accounts/{id}/summary
+  GET  /v3/accounts/{id}/openPositions
+  GET  /v3/accounts/{id}/pricing?instruments=...
+  POST /v3/accounts/{id}/orders                  (MARKET + brackets)
+  PUT  /v3/accounts/{id}/positions/{inst}/close
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional
+
+PRACTICE_HOST = "https://api-fxpractice.oanda.com"
+LIVE_HOST = "https://api-fxtrade.oanda.com"
+
+# transport: (method, url, headers, body-or-None) -> (status, response body)
+Transport = Callable[[str, str, Dict[str, str], Optional[bytes]], Any]
+
+
+def _urllib_transport(method: str, url: str, headers: Dict[str, str],
+                      body: Optional[bytes]):
+    import urllib.request
+
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:  # nosec B310
+        return resp.status, resp.read()
+
+
+class OandaApiError(RuntimeError):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"OANDA API error {status}: {body[:500]}")
+        self.status = status
+        self.body = body
+
+
+class OandaLiveBroker:
+    """Minimal v20 REST trading client.
+
+    Quantities follow OANDA conventions: signed integer units (positive
+    buys, negative sells); prices are decimal strings at the
+    instrument's precision.
+    """
+
+    def __init__(self, token: str, account_id: str, *,
+                 practice: bool = True,
+                 transport: Optional[Transport] = None):
+        if not token or not account_id:
+            raise ValueError("OandaLiveBroker requires token and account_id")
+        self.account_id = account_id
+        self._base = (PRACTICE_HOST if practice else LIVE_HOST)
+        self._headers = {
+            "Authorization": f"Bearer {token}",
+            "Content-Type": "application/json",
+        }
+        self._transport = transport or _urllib_transport
+
+    # ------------------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        body = json.dumps(payload).encode() if payload is not None else None
+        status, raw = self._transport(
+            method, f"{self._base}{path}", dict(self._headers), body
+        )
+        text = raw.decode() if isinstance(raw, (bytes, bytearray)) else str(raw)
+        if not 200 <= int(status) < 300:
+            raise OandaApiError(int(status), text)
+        return json.loads(text) if text else {}
+
+    # ------------------------------------------------------------------
+    def account_summary(self) -> Dict[str, Any]:
+        return self._request(
+            "GET", f"/v3/accounts/{self.account_id}/summary"
+        )["account"]
+
+    def open_positions(self) -> Dict[str, float]:
+        """{instrument: net signed units} for every open position."""
+        data = self._request(
+            "GET", f"/v3/accounts/{self.account_id}/openPositions"
+        )
+        out: Dict[str, float] = {}
+        for pos in data.get("positions", []):
+            units = float(pos.get("long", {}).get("units", 0) or 0) + float(
+                pos.get("short", {}).get("units", 0) or 0
+            )
+            out[pos["instrument"]] = units
+        return out
+
+    def pricing(self, instrument: str) -> Dict[str, float]:
+        data = self._request(
+            "GET",
+            f"/v3/accounts/{self.account_id}/pricing?instruments={instrument}",
+        )
+        price = data["prices"][0]
+        return {
+            "bid": float(price["bids"][0]["price"]),
+            "ask": float(price["asks"][0]["price"]),
+        }
+
+    def market_order(self, instrument: str, units: float, *,
+                     stop_loss: Optional[float] = None,
+                     take_profit: Optional[float] = None,
+                     price_precision: int = 5) -> Dict[str, Any]:
+        """Market order for signed ``units``; brackets attach as
+        on-fill orders (the scan engine's entry-with-brackets flow)."""
+        if units == 0:
+            raise ValueError("market_order requires nonzero units")
+        order: Dict[str, Any] = {
+            "type": "MARKET",
+            "instrument": instrument,
+            "units": str(int(units)),
+            "timeInForce": "FOK",
+            "positionFill": "DEFAULT",
+        }
+        if stop_loss:
+            order["stopLossOnFill"] = {
+                "price": f"{stop_loss:.{price_precision}f}"
+            }
+        if take_profit:
+            order["takeProfitOnFill"] = {
+                "price": f"{take_profit:.{price_precision}f}"
+            }
+        return self._request(
+            "POST", f"/v3/accounts/{self.account_id}/orders",
+            {"order": order},
+        )
+
+    def close_position(self, instrument: str) -> Dict[str, Any]:
+        """Flatten the instrument (both sides, like the scan engine's
+        force-flat)."""
+        return self._request(
+            "PUT",
+            f"/v3/accounts/{self.account_id}/positions/{instrument}/close",
+            {"longUnits": "ALL", "shortUnits": "ALL"},
+        )
+
+
+class TargetOrderRouter:
+    """Bridge from the framework's decision stream to live orders.
+
+    The strategy kernels emit ``(pending_active, pending_target,
+    pending_sl, pending_tp)`` each bar — the same stream the replay
+    engine re-executes.  ``submit_target`` turns one decision into the
+    minimal OANDA action: the units DELTA as a market order (with
+    brackets on opening orders), or a position close when the target is
+    flat.  Idempotent on no-ops (target == current)."""
+
+    def __init__(self, broker: OandaLiveBroker, instrument: str, *,
+                 price_precision: int = 5):
+        self.broker = broker
+        self.instrument = instrument
+        self.price_precision = int(price_precision)
+
+    def submit_target(self, target_units: float, *,
+                      stop_loss: Optional[float] = None,
+                      take_profit: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        current = self.broker.open_positions().get(self.instrument, 0.0)
+        delta = float(target_units) - current
+        if abs(delta) < 1.0:  # sub-unit residual: OANDA units are integral
+            return None
+        if target_units == 0:
+            return self.broker.close_position(self.instrument)
+        return self.broker.market_order(
+            self.instrument, delta,
+            stop_loss=stop_loss, take_profit=take_profit,
+            price_precision=self.price_precision,
+        )

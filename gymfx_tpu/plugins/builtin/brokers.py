@@ -35,17 +35,30 @@ def default_broker(config):
     },
 )
 def oanda_broker(config):
-    """Live-trading stub, hard-gated exactly like the reference
-    (reference broker_plugins/oanda_broker.py:43-46)."""
+    """Live OANDA order routing, hard-gated exactly like the reference
+    (reference broker_plugins/oanda_broker.py:43-46).  Where the
+    reference builds ``bt.stores.OandaStore(...).getbroker()``
+    (:58-63), this returns a ``TargetOrderRouter`` over the v20 REST
+    client (gymfx_tpu/live/oanda.py): the framework's decision stream
+    (pending target + brackets) maps 1:1 onto live orders."""
     if os.environ.get("GYMFX_ENABLE_LIVE") != "1":
         raise RuntimeError(
-            "oanda_broker is a live-trading stub; set GYMFX_ENABLE_LIVE=1 "
+            "oanda_broker places REAL orders; set GYMFX_ENABLE_LIVE=1 "
             "to acknowledge. Simulation uses default_broker."
         )
     token = config.get("oanda_token") or os.environ.get("OANDA_TOKEN")
     account = config.get("oanda_account_id") or os.environ.get("OANDA_ACCOUNT_ID")
     if not token or not account:
         raise ValueError("oanda_broker requires oanda_token and oanda_account_id")
-    raise NotImplementedError(
-        "live OANDA order routing is not part of the simulation framework"
+    from gymfx_tpu.live import OandaLiveBroker, TargetOrderRouter
+
+    broker = OandaLiveBroker(
+        token, account,
+        practice=bool(config.get("oanda_practice", True)),
+        transport=config.get("oanda_transport"),  # tests inject a fake
+    )
+    return TargetOrderRouter(
+        broker,
+        str(config.get("oanda_instrument", "EUR_USD")),
+        price_precision=int(config.get("price_precision", 5)),
     )

@@ -207,6 +207,9 @@ def crosscheck_episode(
     pend_sl = np.asarray(trace["pending_sl"], np.float64)
     pend_tp = np.asarray(trace["pending_tp"], np.float64)
     pos_units = np.asarray(trace["pos_units"], np.float64)
+    bracket_sl = np.asarray(trace["bracket_sl"], np.float64)
+    bracket_tp = np.asarray(trace["bracket_tp"], np.float64)
+    order_denied = np.asarray(trace["order_denied"], np.int64)
     # cap at n_bars: a longer trace ran past exhaustion, where steps are
     # no-ops (the strategy never acts on bars that do not exist)
     n_steps = min(len(pend_active), n_bars)
@@ -229,17 +232,37 @@ def crosscheck_episode(
 
     ohlc_order = env.cfg.intrabar_collision_policy == "ohlc"
     frames: List[MarketFrame] = []
-    levels: Tuple[float, float] = (0.0, 0.0)
     # frames stop at bar n_steps-1, the last bar the scan episode
     # processed: its final pending order never fills (the episode ends
-    # first), so the replay twin leaves it in flight too
+    # first), so the replay twin leaves it in flight too.
+    #
+    # Bar j's intrabar path is built from the scan's RECORDED state, not
+    # inferred from order history (r2 advisor finding, fixed r4):
+    #   walk_pos  the position held through bar j's intrabar phase —
+    #             the pending target when it actually FILLED at bar j's
+    #             open (the order_denied counter not incrementing proves
+    #             it cleared the venue size rules), else the carry-over
+    #             position;
+    #   levels    the bracket prices live DURING bar j: the entry's
+    #             brackets when it armed at bar j's open (same-bar
+    #             arming, DIVERGENCES #6), else the levels still armed
+    #             after step j-1 (state.bracket_sl/tp — zero when flat,
+    #             so exited/cancelled brackets never poison later paths).
     for j in range(min(n_steps, n_bars)):
         if j == 0:
-            walk_pos = 0.0
-        elif pend_active[j - 1]:
-            walk_pos = float(pend_target[j - 1])
+            walk_pos, levels = 0.0, (0.0, 0.0)
         else:
-            walk_pos = float(pos_units[j - 1])
+            filled = bool(pend_active[j - 1]) and not (
+                order_denied[j] > order_denied[j - 1]
+            )
+            if filled:
+                walk_pos = float(pend_target[j - 1])
+            else:
+                walk_pos = float(pos_units[j - 1])
+            if filled and (pend_sl[j - 1] > 0.0 or pend_tp[j - 1] > 0.0):
+                levels = (float(pend_sl[j - 1]), float(pend_tp[j - 1]))
+            else:
+                levels = (float(bracket_sl[j - 1]), float(bracket_tp[j - 1]))
         frames.append(
             MarketFrame(
                 instrument_id=spec.instrument_id,
@@ -256,11 +279,6 @@ def crosscheck_episode(
                 ),
             )
         )
-        # only the most recent bracket-carrying order can be armed
-        # (brackets arm on entry fills and clear on flat/flip), so its
-        # levels are the only candidate trigger prices for later bars
-        if pend_active[j] and (pend_sl[j] > 0.0 or pend_tp[j] > 0.0):
-            levels = (float(pend_sl[j]), float(pend_tp[j]))
 
     target_actions = [
         TargetAction(

@@ -185,7 +185,8 @@ def load_params(directory: str, template: Optional[Any] = None) -> Tuple[Any, in
 # checkpoints synthesize these instead of failing (each entry documents
 # the round that added the field).
 _MIGRATED_FIELDS = {
-    "pending_forced",  # r4: venue-forced liquidation flag (False at rest)
+    "pending_forced",      # r4: venue-forced liquidation flag (False at rest)
+    "termination_reason",  # r4: explicit TERMINATION_* code (0 = running)
 }
 
 

@@ -109,6 +109,42 @@ def test_min_equity_termination():
     assert eq[k] <= 100.0 + 1e-6
 
 
+def test_termination_reason_distinguishes_bankruptcy_from_exhaustion():
+    """Explicit termination_reason (r2 advisor finding, fixed r4): a
+    bar-cursor heuristic cannot tell a final-bar bankruptcy from
+    exhaustion; the latched state flag can."""
+    from gymfx_tpu.core.types import (
+        TERMINATION_BANKRUPT,
+        TERMINATION_EXHAUSTED,
+        TERMINATION_RUNNING,
+    )
+
+    # mid-episode bankruptcy
+    n = 30
+    closes = np.concatenate([np.full(5, 1.0), np.full(n - 5, 0.5)])
+    env = make_env(make_df(closes), position_size=25000.0, min_equity=100.0,
+                   initial_cash=10000.0)
+    state, out = env.rollout(R.buy_hold_driver(), steps=20)
+    assert int(state.termination_reason) == TERMINATION_BANKRUPT
+    # ordinary exhaustion
+    env = make_env(uptrend_df(12))
+    state, out = env.rollout(R.flat_driver(), steps=15)
+    assert int(state.termination_reason) == TERMINATION_EXHAUSTED
+    # a live episode reports running
+    env = make_env(uptrend_df(40))
+    state, out = env.rollout(R.flat_driver(), steps=5)
+    assert int(state.termination_reason) == TERMINATION_RUNNING
+    # the advisor's case: equity crashes through the floor ON the final
+    # bar — the cursor sits at n_bars-1 (looks exhausted) but the reason
+    # says bankrupt
+    closes = np.concatenate([np.full(11, 1.0), [0.5]])
+    env = make_env(make_df(closes), position_size=25000.0, min_equity=100.0,
+                   initial_cash=10000.0)
+    state, out = env.rollout(R.buy_hold_driver(), steps=15)
+    assert int(state.t) == env.n_bars - 1
+    assert int(state.termination_reason) == TERMINATION_BANKRUPT
+
+
 def test_data_exhaustion_terminates():
     env = make_env(uptrend_df(12))  # 12 bars
     state, out = env.rollout(R.flat_driver(), steps=15)

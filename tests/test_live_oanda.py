@@ -1,0 +1,155 @@
+"""Live OANDA order routing (closes VERDICT r4 Missing #3): the v20
+client + decision-stream router, driven offline through an injected
+fake transport — no network, no real orders."""
+import json
+
+import pytest
+
+from gymfx_tpu.live.oanda import (
+    LIVE_HOST,
+    PRACTICE_HOST,
+    OandaApiError,
+    OandaLiveBroker,
+    TargetOrderRouter,
+)
+
+
+class FakeTransport:
+    """Records requests; replies from a programmable route table."""
+
+    def __init__(self):
+        self.calls = []
+        self.routes = {}
+
+    def route(self, method, path_part, status, payload):
+        self.routes[(method, path_part)] = (status, json.dumps(payload).encode())
+
+    def __call__(self, method, url, headers, body):
+        self.calls.append(
+            {
+                "method": method,
+                "url": url,
+                "headers": headers,
+                "body": json.loads(body) if body else None,
+            }
+        )
+        for (m, part), (status, resp) in self.routes.items():
+            if m == method and part in url:
+                return status, resp
+        return 200, b"{}"
+
+
+def _broker(**over):
+    t = FakeTransport()
+    return OandaLiveBroker("tok", "acct-1", transport=t, **over), t
+
+
+def test_requires_credentials():
+    with pytest.raises(ValueError, match="token"):
+        OandaLiveBroker("", "acct")
+    with pytest.raises(ValueError, match="token"):
+        OandaLiveBroker("tok", "")
+
+
+def test_practice_vs_live_hosts():
+    b, t = _broker(practice=True)
+    b._request("GET", "/x")
+    assert t.calls[0]["url"].startswith(PRACTICE_HOST)
+    b2, t2 = _broker(practice=False)
+    b2._request("GET", "/x")
+    assert t2.calls[0]["url"].startswith(LIVE_HOST)
+
+
+def test_auth_header_and_error_surface():
+    b, t = _broker()
+    t.route("GET", "/summary", 200, {"account": {"balance": "1000.0"}})
+    acct = b.account_summary()
+    assert acct["balance"] == "1000.0"
+    assert t.calls[0]["headers"]["Authorization"] == "Bearer tok"
+    t.route("GET", "/summary", 401, {"errorMessage": "bad token"})
+    with pytest.raises(OandaApiError, match="401"):
+        b.account_summary()
+
+
+def test_market_order_payload_with_brackets():
+    b, t = _broker()
+    b.market_order("EUR_USD", -2500, stop_loss=1.2345678, take_profit=1.1)
+    order = t.calls[0]["body"]["order"]
+    assert t.calls[0]["method"] == "POST"
+    assert "/v3/accounts/acct-1/orders" in t.calls[0]["url"]
+    assert order["type"] == "MARKET"
+    assert order["units"] == "-2500"          # signed integral units
+    assert order["stopLossOnFill"]["price"] == "1.23457"  # 5-digit precision
+    assert order["takeProfitOnFill"]["price"] == "1.10000"
+    with pytest.raises(ValueError, match="nonzero"):
+        b.market_order("EUR_USD", 0)
+
+
+def test_open_positions_nets_long_and_short():
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {
+        "positions": [
+            {"instrument": "EUR_USD", "long": {"units": "3000"},
+             "short": {"units": "0"}},
+            {"instrument": "USD_JPY", "long": {"units": "0"},
+             "short": {"units": "-1500"}},
+        ]
+    })
+    assert b.open_positions() == {"EUR_USD": 3000.0, "USD_JPY": -1500.0}
+
+
+def test_router_maps_decision_stream_to_orders():
+    """The pending-target stream (the same one the replay engine
+    re-executes) becomes delta market orders / closes / no-ops."""
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {
+        "positions": [{"instrument": "EUR_USD",
+                       "long": {"units": "1000"}, "short": {"units": "0"}}]
+    })
+    router = TargetOrderRouter(b, "EUR_USD")
+    # flip long 1000 -> short 2000: one -3000 market order with brackets
+    router.submit_target(-2000, stop_loss=1.25, take_profit=1.15)
+    order = t.calls[-1]["body"]["order"]
+    assert order["units"] == "-3000"
+    assert order["stopLossOnFill"]["price"] == "1.25000"
+    # target flat -> position close endpoint, both sides
+    router.submit_target(0)
+    close = t.calls[-1]
+    assert close["method"] == "PUT"
+    assert "/positions/EUR_USD/close" in close["url"]
+    assert close["body"] == {"longUnits": "ALL", "shortUnits": "ALL"}
+
+
+def test_router_noop_at_target():
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {
+        "positions": [{"instrument": "EUR_USD",
+                       "long": {"units": "1000"}, "short": {"units": "0"}}]
+    })
+    router = TargetOrderRouter(b, "EUR_USD")
+    assert router.submit_target(1000) is None
+    # only the position poll hit the wire
+    assert [c["method"] for c in t.calls] == ["GET"]
+
+
+def test_plugin_gate_and_wiring(monkeypatch):
+    from gymfx_tpu.plugins.registry import load_plugin
+
+    monkeypatch.delenv("GYMFX_ENABLE_LIVE", raising=False)
+    plugin, _required = load_plugin("broker.plugins", "oanda_broker")
+    with pytest.raises(RuntimeError, match="GYMFX_ENABLE_LIVE"):
+        plugin({"oanda_token": "t", "oanda_account_id": "a"})
+
+    monkeypatch.setenv("GYMFX_ENABLE_LIVE", "1")
+    with pytest.raises(ValueError, match="oanda_token"):
+        plugin({})
+
+    t = FakeTransport()
+    router = plugin({
+        "oanda_token": "tok", "oanda_account_id": "acct-1",
+        "oanda_instrument": "GBP_USD", "oanda_transport": t,
+    })
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    router.submit_target(500)
+    order = t.calls[-1]["body"]["order"]
+    assert order["instrument"] == "GBP_USD" and order["units"] == "500"

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 from gymfx_tpu.core.portfolio import PortfolioEnvironment
+from tests.helpers import make_df
 
 FILES = {
     "EUR_USD": "examples/data/eurusd_sample.csv",
@@ -193,6 +194,122 @@ def test_portfolio_pbt_reports_held_out_eval():
     assert s["eval_scope"] == "held_out"
     assert "in_sample" in s and np.isfinite(s["final_equity"])
     assert len(s["pbt"]["clip_eps"]) == 2  # widened exploration surface
+
+
+def _drift_fixture(tmp_path, jpy_path):
+    """EUR/USD flat at 1.0; USD/JPY trades early then moves hard: any
+    equity change after the JPY position closes is pure conversion
+    drift on the realized (yen-denominated) pnl."""
+    n = 16
+    eur = make_df([1.0] * n)
+    # JPY: rises 100 -> 110 while held, then crashes to 55 after close
+    jpy_closes = [100.0, 100.0, 105.0, 110.0, 110.0] + [110.0, 90.0, 70.0, 55.0] + [55.0] * (n - 9)
+    jpy = make_df(jpy_closes)
+    a, b = tmp_path / "eur.csv", tmp_path / jpy_path
+    eur.reset_index().to_csv(a, index=False)
+    jpy.reset_index().to_csv(b, index=False)
+    return {
+        "portfolio_files": {"EUR_USD": str(a), "USD_JPY": str(b)},
+        "window_size": 4, "initial_cash": 10000.0,
+        "portfolio_position_sizes": [0.0, 1000.0],
+    }
+
+
+def _run_drift_episode(config):
+    env = PortfolioEnvironment(config)
+    state, obs = env.reset()
+    # long JPY on the warmup bar (fills bar 1 open), close at bar 3
+    # (fills bar 4 open at 110), then hold while USDJPY crashes
+    plan = [[0, 1], [0, 0], [0, 0], [0, 3]] + [[0, 0]] * 10
+    equities = []
+    for row in plan:
+        state, obs, r, d, info = env.step(state, np.asarray(row, np.int32))
+        equities.append(float(info["equity"]))
+    return env, np.asarray(equities)
+
+
+def test_realized_pnl_conversion_drift_is_exactly_characterized(tmp_path):
+    """VERDICT r4 item #8: default mode lets realized yen pnl float with
+    FX — the drift equals realized_q * (conv_now - conv_at_close)
+    EXACTLY, and sweep_realized_pnl eliminates it (fill-time banking)."""
+    config = _drift_fixture(tmp_path, "jpy.csv")
+    env, eq_default = _run_drift_episode(config)
+    env_s, eq_swept = _run_drift_episode({**config, "sweep_realized_pnl": True})
+    assert env_s.cfg.sweep_realized_pnl
+
+    # realized pnl: long 1000 @100 (bar1 open), closed @110 (bar4 open)
+    # -> +10_000 JPY parked in yen
+    realized_q = 1000.0 * (110.0 - 100.0)
+    # step index: plan step i lands on bar i (warmup at bar 0)
+    # bars 5..8: rate crashes 110 -> 55; conv = 1/USDJPY
+    closes = [100.0, 100.0, 105.0, 110.0, 110.0, 110.0, 90.0, 70.0, 55.0]
+    conv_at_close = 1.0 / 110.0
+    for step, c in ((5, 110.0), (6, 90.0), (7, 70.0), (8, 55.0)):
+        drift = realized_q * (1.0 / c - conv_at_close)
+        # default: equity floats with the yen rate by exactly the drift
+        assert eq_default[step] - eq_default[4] == pytest.approx(
+            drift, rel=1e-4, abs=0.02
+        )
+        # swept: realized pnl banked at the close-time rate, immune
+        assert eq_swept[step] == pytest.approx(eq_swept[4], abs=0.02)
+    # both modes agree while the position was OPEN in unrealized-only
+    # territory at the same rate basis (bar 1: entry bar, no realized)
+    assert eq_default[1] == pytest.approx(eq_swept[1], abs=0.02)
+    # swept final equity equals initial + realized converted at close
+    # time (10_000 JPY at 1/110)
+    assert eq_swept[-1] - 10000.0 == pytest.approx(
+        realized_q / 110.0, rel=1e-3
+    )
+
+
+def test_conversion_drift_bound_at_scale(tmp_path):
+    """The default-mode drift on a long high-volatility episode is
+    bounded by max|conv change| * |realized_q| — the committed scale
+    bound the bake-off fixture tolerance cannot cover."""
+    config = _drift_fixture(tmp_path, "jpy2.csv")
+    _, eq_default = _run_drift_episode(config)
+    _, eq_swept = _run_drift_episode({**config, "sweep_realized_pnl": True})
+    realized_q = 1000.0 * (110.0 - 100.0)
+    max_conv_move = abs(1.0 / 55.0 - 1.0 / 110.0)
+    bound = realized_q * max_conv_move + 0.05
+    assert np.max(np.abs(eq_default - eq_swept)) <= bound
+
+
+def test_sweep_mode_preflight_uses_banked_realized(tmp_path):
+    """With sweep_realized_pnl on, the margin preflight's free balance
+    must be the BANKED realized pnl (historic rates) — not the whole
+    realized ledger re-converted at today's rate, which would grant
+    margin the swept equity cannot support (r4 review finding)."""
+    base = _drift_fixture(tmp_path, "jpy3.csv")
+    base.update(
+        portfolio_position_sizes=[203_000.0, 1000.0],
+        enforce_margin_preflight=True,
+        margin_init=0.05, leverage=1.0, margin_model="leveraged",
+    )
+    # long JPY at warmup, close at bar 3 (realize +10k JPY banked at
+    # 1/110), hold through the crash to 55, then try a HUGE EUR order at
+    # bar 8: required margin 203k*0.05 = 10_150 sits between the swept
+    # free balance (10_000 + 10k/110 = 10_090.9) and the stale
+    # re-converted one (10_000 + 10k/55 = 10_181.8)
+    plan = [[0, 1], [0, 0], [0, 0], [0, 3]] + [[0, 0]] * 4 + [[1, 0]] + [[0, 0]] * 2
+
+    def run(**over):
+        env = PortfolioEnvironment({**base, **over})
+        state, obs = env.reset()
+        last = None
+        for row in plan:
+            state, obs, r, d, info = env.step(state, np.asarray(row, np.int32))
+            last = info
+        return last
+
+    legacy = run()
+    swept = run(sweep_realized_pnl=True)
+    # legacy (float-with-FX) measure grants the order
+    assert np.asarray(legacy["positions"]).tolist()[0] == 1
+    assert int(legacy["blocked_margin"]) == 0
+    # sweep mode denies it: banked equity cannot support the margin
+    assert np.asarray(swept["positions"]).tolist()[0] == 0
+    assert int(swept["blocked_margin"]) == 1
 
 
 def test_portfolio_cli_training(tmp_path):

@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""Per-policy TPU benchmark sweep -> examples/results/tpu_bench_sweep.json.
+
+Covers every BASELINE policy family in ONE dtype configuration (bf16
+policy compute, f32 params — the shipped default of bench.py):
+
+  * PPO MLP at several env-batch widths (the flagship path), with a
+    rollout-vs-update wall-time split on the widest rows so batch-width
+    rollovers are EXPLAINED by measurement, not guessed at;
+  * PPO LSTM and PPO transformer_ring (BASELINE config 4's recurrent /
+    attention policies);
+  * portfolio PPO (BASELINE config 5, multi-pair book).
+
+Each row reports env steps/sec/chip and MFU (XLA-cost-model FLOPs of
+the fused train step over the chip's public peak bf16 throughput —
+gymfx_tpu/bench_util.py).
+
+Usage:
+  python tools/tpu_bench.py [--quick] [--iters K] [--output PATH]
+
+The reference's evidence discipline for this file:
+/root/reference/tools/simulation_engine_benchmark.py:113-124 (committed
+JSON with workload + date + device provenance).
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+BASELINE_PER_CHIP = 125_000.0  # BASELINE.json: 1M env steps/s on 8 chips
+
+
+def _single_pair_trainer(policy: str, n_envs: int, horizon: int, **over):
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        input_data_file="examples/data/eurusd_sample.csv",
+        num_envs=n_envs, ppo_horizon=horizon, ppo_epochs=1,
+        ppo_minibatches=4, policy=policy, policy_dtype="bfloat16",
+        window_size=32,
+    )
+    config.update(over)
+    env = Environment(config)
+    return PPOTrainer(env, ppo_config_from(config))
+
+
+def _portfolio_trainer(n_envs: int, horizon: int):
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+    from gymfx_tpu.train.portfolio_ppo import (
+        PortfolioPPOConfig,
+        PortfolioPPOTrainer,
+    )
+
+    env = PortfolioEnvironment(
+        {
+            "portfolio_files": {
+                "EUR_USD": "examples/data/eurusd_sample.csv",
+                "GBP_USD": "examples/data/gbpusd_sample.csv",
+                "USD_JPY": "examples/data/usdjpy_sample.csv",
+            },
+            "window_size": 32,
+        }
+    )
+    pcfg = PortfolioPPOConfig(n_envs=n_envs, horizon=horizon, epochs=1,
+                              minibatches=4, policy="mlp")
+    return PortfolioPPOTrainer(env, pcfg)
+
+
+def _measure(trainer, n_envs: int, horizon: int, iters: int,
+             split_rollout: bool = False):
+    """(steps/sec, mfu, split) for the fused train step."""
+    import jax
+
+    from gymfx_tpu.bench_util import compile_with_flops, mfu
+
+    state = trainer.init_state(0)
+    # ONE compilation serves cost analysis and execution
+    compiled, flops = compile_with_flops(trainer._train_step, state)
+    step = compiled if compiled is not None else trainer.train_step
+    state, _ = step(state)  # warmup
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    split = None
+    if split_rollout and hasattr(trainer, "_rollout"):
+        roll = jax.jit(trainer._rollout)
+        out = roll(state.params, state.env_states, state.obs_vec,
+                   state.policy_carry, state.rng)
+        jax.block_until_ready(out[4])
+        r0 = time.perf_counter()
+        for _ in range(iters):
+            out = roll(state.params, state.env_states, state.obs_vec,
+                       state.policy_carry, state.rng)
+        jax.block_until_ready(out[4])
+        rdt = time.perf_counter() - r0
+        split = {
+            "rollout_seconds_per_iter": rdt / iters,
+            "update_seconds_per_iter": max(dt - rdt, 0.0) / iters,
+        }
+
+    steps = n_envs * horizon * iters
+    device = jax.devices()[0]
+    return steps / dt, mfu(flops, iters, dt, device), flops, split
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (CI smoke; artifact not written)")
+    ap.add_argument("--output", default="examples/results/tpu_bench_sweep.json")
+    args = ap.parse_args()
+
+    import jax
+
+    device = jax.devices()[0]
+    horizon = 64
+    if args.quick:
+        mlp_widths = [64, 128]
+        jobs = [("mlp", w, horizon, False) for w in mlp_widths]
+        jobs += [("lstm", 64, 16, False), ("transformer_ring", 32, 16, False),
+                 ("portfolio_mlp", 32, 16, False)]
+        args.iters = 2
+    else:
+        jobs = [
+            ("mlp", 1024, horizon, False),
+            ("mlp", 8192, horizon, True),    # sweet spot: split timed
+            ("mlp", 16384, horizon, True),
+            ("mlp", 32768, horizon, True),   # rollover row: split timed
+            ("lstm", 4096, horizon, False),
+            ("transformer_ring", 1024, horizon, False),
+            ("portfolio_mlp", 2048, horizon, False),
+        ]
+
+    rows = []
+    for policy, n_envs, hor, split in jobs:
+        if policy == "portfolio_mlp":
+            trainer = _portfolio_trainer(n_envs, hor)
+        else:
+            trainer = _single_pair_trainer(policy, n_envs, hor)
+        sps, util, flops, split_out = _measure(
+            trainer, n_envs, hor, args.iters, split_rollout=split
+        )
+        row = {
+            "policy": policy,
+            "n_envs": n_envs,
+            "horizon": hor,
+            "env_steps_per_sec_per_chip": round(sps, 1),
+            "vs_baseline": round(sps / BASELINE_PER_CHIP, 3),
+            "mfu": round(util, 5) if util is not None else None,
+            "step_flops_xla": flops,
+        }
+        if policy == "portfolio_mlp":
+            row["n_pairs"] = 3
+        if split_out:
+            row["wall_split"] = {
+                k: round(v, 5) for k, v in split_out.items()
+            }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        del trainer
+
+    artifact = {
+        "schema": "tpu_bench_sweep.v2",
+        "date_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        "device": str(getattr(device, "device_kind", device.platform)),
+        "platform": device.platform,
+        "dtype": "bf16 policy compute, f32 params/optimizer (one "
+                 "configuration end-to-end; bench.py headline config)",
+        "workload": "fused PPO rollout+update per policy family, EUR/USD "
+                    "1-min example bars (portfolio row: 3-pair book), "
+                    f"horizon=64, iters={args.iters}",
+        "baseline_per_chip": BASELINE_PER_CHIP,
+        "mfu_definition": "XLA cost-model FLOPs of the compiled train "
+                          "step / public peak dense-bf16 chip FLOPs "
+                          "(gymfx_tpu/bench_util.py)",
+        "sweep": rows,
+    }
+    if not args.quick:
+        out = Path(args.output)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(artifact, indent=1))
+        print(f"wrote {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
