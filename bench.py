@@ -13,7 +13,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 # Honor JAX_PLATFORMS=cpu even where sitecustomize force-registers a
 # remote accelerator plugin that overrides the env var.
@@ -61,7 +60,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n_envs", type=int, default=8192)
     ap.add_argument("--horizon", type=int, default=64)
-    ap.add_argument("--iters", type=int, default=5)
+    # default 20 per bench_util.DEFAULT_BENCH_ITERS (dispatch-latency
+    # amortization — the round-3 "headline regression" was 5-iter noise)
+    ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--quick", action="store_true", help="small shapes (CI)")
     args = ap.parse_args()
     if args.quick:
@@ -91,20 +92,10 @@ def main() -> None:
     env = Environment(config)
     trainer = PPOTrainer(env, ppo_config_from(config))
 
-    from gymfx_tpu.bench_util import compile_with_flops, mfu
+    from gymfx_tpu.bench_util import measure_train_step, mfu
 
     state = trainer.init_state(0)
-    # ONE compilation serves cost analysis and execution
-    compiled, step_flops = compile_with_flops(trainer._train_step, state)
-    step = compiled if compiled is not None else trainer.train_step
-    state, _ = step(state)  # warmup
-    jax.block_until_ready(state.params)
-
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        state, metrics = step(state)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    dt, step_flops, state = measure_train_step(trainer, state, args.iters)
 
     env_steps = args.n_envs * args.horizon * args.iters
     steps_per_sec = env_steps / dt

@@ -37,12 +37,18 @@ Transport = Callable[[str, str, Dict[str, str], Optional[bytes]], Any]
 
 def _urllib_transport(method: str, url: str, headers: Dict[str, str],
                       body: Optional[bytes]):
+    import urllib.error
     import urllib.request
 
     req = urllib.request.Request(url, data=body, headers=headers,
                                  method=method)
-    with urllib.request.urlopen(req, timeout=30) as resp:  # nosec B310
-        return resp.status, resp.read()
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:  # nosec B310
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        # non-2xx must flow back as (status, body) so _request raises the
+        # module's own OandaApiError with OANDA's errorMessage attached
+        return e.code, e.read()
 
 
 class OandaApiError(RuntimeError):

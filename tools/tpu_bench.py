@@ -27,17 +27,15 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
-import os
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
-    import jax as _jax
+from gymfx_tpu.bench_util import ensure_cpu_if_requested
 
-    _jax.config.update("jax_platforms", "cpu")
+ensure_cpu_if_requested()
 
 BASELINE_PER_CHIP = 125_000.0  # BASELINE.json: 1M env steps/s on 8 chips
 
@@ -86,22 +84,16 @@ def _measure(trainer, n_envs: int, horizon: int, iters: int,
     """(steps/sec, mfu, split) for the fused train step."""
     import jax
 
-    from gymfx_tpu.bench_util import compile_with_flops, mfu
+    from gymfx_tpu.bench_util import measure_train_step, mfu
 
     state = trainer.init_state(0)
-    # ONE compilation serves cost analysis and execution
-    compiled, flops = compile_with_flops(trainer._train_step, state)
-    step = compiled if compiled is not None else trainer.train_step
-    state, _ = step(state)  # warmup
-    jax.block_until_ready(state.params)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state)
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+    dt, flops, state = measure_train_step(trainer, state, iters)
 
     split = None
-    if split_rollout and hasattr(trainer, "_rollout"):
+    # the split harness drives the single-pair rollout signature
+    # (params, env_states, obs_vec, policy_carry, rng); the portfolio
+    # trainer has a different one — guard on the actual capability
+    if split_rollout and hasattr(state, "policy_carry"):
         roll = jax.jit(trainer._rollout)
         out = roll(state.params, state.env_states, state.obs_vec,
                    state.policy_carry, state.rng)
@@ -124,7 +116,9 @@ def _measure(trainer, n_envs: int, horizon: int, iters: int,
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--iters", type=int, default=10)
+    from gymfx_tpu.bench_util import DEFAULT_BENCH_ITERS
+
+    ap.add_argument("--iters", type=int, default=DEFAULT_BENCH_ITERS)
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes (CI smoke; artifact not written)")
     ap.add_argument("--output", default="examples/results/tpu_bench_sweep.json")

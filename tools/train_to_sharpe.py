@@ -17,17 +17,15 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
-import os
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
-    import jax as _jax
+from gymfx_tpu.bench_util import ensure_cpu_if_requested
 
-    _jax.config.update("jax_platforms", "cpu")
+ensure_cpu_if_requested()
 
 
 def main() -> int:
@@ -83,15 +81,23 @@ def main() -> int:
             "train_total_steps": config["train_total_steps"],
         },
         "result": {
+            # wall clock INCLUDES XLA compilation of the train + eval
+            # programs (cold-cache honesty); the steady-state training
+            # rate rides along for the compute-only picture
             "wall_clock_seconds": round(wall, 2),
+            "train_env_steps_per_sec": round(
+                summary["train_metrics"]["env_steps_per_sec"], 1
+            ),
             "env_steps": summary["train_metrics"]["total_env_steps"],
             "train_bars": summary["train_bars"],
             "eval_bars": summary["eval_bars"],
             "eval_scope": summary["eval_scope"],
             "sharpe_held_out": summary["sharpe_ratio_steps"],
             "total_return_held_out": summary["total_return"],
+            "trades_held_out": summary["trades_total"],
             "sharpe_in_sample": summary["in_sample"]["sharpe_ratio_steps"],
             "total_return_in_sample": summary["in_sample"]["total_return"],
+            "trades_in_sample": summary["in_sample"]["trades_total"],
         },
     }
     print(json.dumps(artifact["result"]), flush=True)
