@@ -15,11 +15,11 @@ import os
 import sys
 
 # Honor JAX_PLATFORMS=cpu even where sitecustomize force-registers a
-# remote accelerator plugin that overrides the env var.
-if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
-    import jax as _jax
+# remote accelerator plugin that overrides the env var (the shared
+# workaround, parallel/mesh.py honor_jax_platforms_env).
+from gymfx_tpu.bench_util import ensure_cpu_if_requested
 
-    _jax.config.update("jax_platforms", "cpu")
+ensure_cpu_if_requested()
 
 
 def _probe_device(timeout_s: int = 240) -> None:

@@ -10,20 +10,16 @@ alone (no hand-counted FLOP formulas to drift out of date).
 """
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, Optional
 
 
 def ensure_cpu_if_requested() -> None:
-    """Honor ``JAX_PLATFORMS=cpu`` even where sitecustomize
-    force-registers a remote accelerator plugin that overrides the env
-    var (bench.py documents the same quirk).  Call BEFORE other jax
-    work; safe no-op elsewhere."""
-    if os.environ.get("JAX_PLATFORMS", "").lower().split(",")[0].strip() == "cpu":
-        import jax
+    """Tool-entry alias for ``parallel.mesh.honor_jax_platforms_env``
+    (ONE definition of the sitecustomize-override workaround)."""
+    from gymfx_tpu.parallel.mesh import honor_jax_platforms_env
 
-        jax.config.update("jax_platforms", "cpu")
+    honor_jax_platforms_env()
 
 
 # 20 timed iterations by default: each dispatch pays ~10ms host->device
@@ -42,11 +38,11 @@ def measure_train_step(trainer: Any, state: Any, iters: int):
     compiled, flops = compile_with_flops(trainer._train_step, state)
     step = compiled if compiled is not None else trainer.train_step
     state, _ = step(state)  # warmup
-    jax.block_until_ready(state.params)
+    jax.block_until_ready(state)  # whole pytree: works for every trainer
     t0 = time.perf_counter()
     for _ in range(iters):
         state, _metrics = step(state)
-    jax.block_until_ready(state.params)
+    jax.block_until_ready(state)
     return time.perf_counter() - t0, flops, state
 
 # Public per-chip peak dense bf16 FLOPs/sec (vendor-published specs).

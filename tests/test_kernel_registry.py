@@ -268,11 +268,12 @@ def test_custom_kernels_work_in_portfolio(tmp_path):
 
 
 def test_oanda_broker_stub_gating(monkeypatch):
-    """The live-broker stub is hard-gated exactly like the reference
+    """The live broker is hard-gated exactly like the reference
     (reference broker_plugins/oanda_broker.py:43-46): without the
     acknowledgement env var it refuses; with it but without credentials
-    it demands them; with both it stops at the not-implemented routing
-    boundary (no live trading from a simulation framework)."""
+    it demands them; with both it builds the WORKING order router
+    (r4 closes the routing gap — full payload tests live in
+    tests/test_live_oanda.py)."""
     import pytest
 
     from gymfx_tpu.plugins.registry import load_plugin
@@ -290,5 +291,8 @@ def test_oanda_broker_stub_gating(monkeypatch):
     with pytest.raises(ValueError, match="oanda_token"):
         plugin({})
 
-    with pytest.raises(NotImplementedError):
-        plugin({"oanda_token": "t", "oanda_account_id": "a"})
+    from gymfx_tpu.live import TargetOrderRouter
+
+    router = plugin({"oanda_token": "t", "oanda_account_id": "a"})
+    assert isinstance(router, TargetOrderRouter)
+    assert router.instrument == "EUR_USD"
