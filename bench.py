@@ -95,7 +95,7 @@ def main() -> None:
     from gymfx_tpu.bench_util import measure_train_step, mfu
 
     state = trainer.init_state(0)
-    dt, step_flops, state = measure_train_step(trainer, state, args.iters)
+    dt, step_flops, state, _step = measure_train_step(trainer, state, args.iters)
 
     env_steps = args.n_envs * args.horizon * args.iters
     steps_per_sec = env_steps / dt

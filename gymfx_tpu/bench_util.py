@@ -32,7 +32,9 @@ DEFAULT_BENCH_ITERS = 20
 def measure_train_step(trainer: Any, state: Any, iters: int):
     """One shared timing harness for every benchmark: AOT-compile once
     (cost analysis + execution off the same executable), warmup, timed
-    loop.  Returns ``(seconds, flops_per_iter, final_state)``."""
+    loop.  Returns ``(seconds, flops_per_iter, final_state, step)`` —
+    ``step`` is the compiled callable so callers (e.g. the profiler
+    capture) never trigger a second compilation of the same program."""
     import jax
 
     compiled, flops = compile_with_flops(trainer._train_step, state)
@@ -43,7 +45,7 @@ def measure_train_step(trainer: Any, state: Any, iters: int):
     for _ in range(iters):
         state, _metrics = step(state)
     jax.block_until_ready(state)
-    return time.perf_counter() - t0, flops, state
+    return time.perf_counter() - t0, flops, state, step
 
 # Public per-chip peak dense bf16 FLOPs/sec (vendor-published specs).
 PEAK_BF16_FLOPS = {

@@ -38,6 +38,26 @@ def test_mfu_arithmetic():
     assert mfu(1e12, 10, 0.0, dev) is None
 
 
+def test_sweep_trainer_builders_honor_window():
+    """The sweep's artifact rows record the job's window — every trainer
+    builder must actually build the env at that window (r4 review
+    finding: a silently-ignored window would publish a configuration
+    that was never run)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from tools.tpu_bench import (
+        _impala_trainer,
+        _portfolio_trainer,
+        _single_pair_trainer,
+    )
+
+    assert _single_pair_trainer("mlp", 8, 8, window=16).env.cfg.window_size == 16
+    assert _impala_trainer(8, 8, window=16).env.cfg.window_size == 16
+    assert _portfolio_trainer(8, 8, window=16).env.cfg.window_size == 16
+
+
 def test_compiled_step_flops_counts_a_matmul():
     import jax
     import jax.numpy as jnp

@@ -40,7 +40,8 @@ ensure_cpu_if_requested()
 BASELINE_PER_CHIP = 125_000.0  # BASELINE.json: 1M env steps/s on 8 chips
 
 
-def _single_pair_trainer(policy: str, n_envs: int, horizon: int, **over):
+def _single_pair_trainer(policy: str, n_envs: int, horizon: int,
+                         window: int = 32, **over):
     from gymfx_tpu.config import DEFAULT_VALUES
     from gymfx_tpu.core.runtime import Environment
     from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
@@ -50,14 +51,14 @@ def _single_pair_trainer(policy: str, n_envs: int, horizon: int, **over):
         input_data_file="examples/data/eurusd_sample.csv",
         num_envs=n_envs, ppo_horizon=horizon, ppo_epochs=1,
         ppo_minibatches=4, policy=policy, policy_dtype="bfloat16",
-        window_size=32,
+        window_size=window,
     )
     config.update(over)
     env = Environment(config)
     return PPOTrainer(env, ppo_config_from(config))
 
 
-def _impala_trainer(n_envs: int, unroll: int):
+def _impala_trainer(n_envs: int, unroll: int, window: int = 32):
     """BASELINE config 4 exactly: dd-penalized reward + LSTM policy +
     IMPALA actor-learner (V-trace)."""
     from gymfx_tpu.config import DEFAULT_VALUES
@@ -69,13 +70,13 @@ def _impala_trainer(n_envs: int, unroll: int):
         input_data_file="examples/data/eurusd_sample.csv",
         num_envs=n_envs, impala_unroll=unroll, policy="lstm",
         policy_dtype="bfloat16", reward_plugin="dd_penalized_reward",
-        window_size=32,
+        window_size=window,
     )
     env = Environment(config)
     return ImpalaTrainer(env, impala_config_from(config))
 
 
-def _portfolio_trainer(n_envs: int, horizon: int):
+def _portfolio_trainer(n_envs: int, horizon: int, window: int = 32):
     from gymfx_tpu.core.portfolio import PortfolioEnvironment
     from gymfx_tpu.train.portfolio_ppo import (
         PortfolioPPOConfig,
@@ -89,7 +90,7 @@ def _portfolio_trainer(n_envs: int, horizon: int):
                 "GBP_USD": "examples/data/gbpusd_sample.csv",
                 "USD_JPY": "examples/data/usdjpy_sample.csv",
             },
-            "window_size": 32,
+            "window_size": window,
         }
     )
     pcfg = PortfolioPPOConfig(n_envs=n_envs, horizon=horizon, epochs=1,
@@ -98,14 +99,24 @@ def _portfolio_trainer(n_envs: int, horizon: int):
 
 
 def _measure(trainer, n_envs: int, horizon: int, iters: int,
-             split_rollout: bool = False):
-    """(steps/sec, mfu, split) for the fused train step."""
+             split_rollout: bool = False, profile_dir=None):
+    """(steps/sec, mfu, flops, split) for the fused train step; with
+    ``profile_dir``, also captures one jax.profiler trace of the SAME
+    compiled executable and state (no second compilation)."""
     import jax
 
     from gymfx_tpu.bench_util import measure_train_step, mfu
 
     state = trainer.init_state(0)
-    dt, flops, state = measure_train_step(trainer, state, iters)
+    dt, flops, state, step = measure_train_step(trainer, state, iters)
+
+    if profile_dir is not None:
+        import jax.profiler
+
+        profile_dir.mkdir(parents=True, exist_ok=True)
+        with jax.profiler.trace(str(profile_dir)):
+            state, _ = step(state)
+            jax.block_until_ready(state)
 
     split = None
     # the split harness drives the single-pair PPO rollout signature
@@ -141,6 +152,9 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes (CI smoke; artifact not written)")
     ap.add_argument("--output", default="examples/results/tpu_bench_sweep.json")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="also capture a jax.profiler trace of one "
+                         "train step per row into DIR/<policy>_<n_envs>")
     args = ap.parse_args()
 
     import jax
@@ -149,38 +163,49 @@ def main() -> int:
     horizon = 64
     if args.quick:
         mlp_widths = [64, 128]
-        jobs = [("mlp", w, horizon, False) for w in mlp_widths]
-        jobs += [("lstm", 64, 16, False), ("transformer_ring", 32, 16, False),
-                 ("impala_lstm", 64, 16, False),
-                 ("portfolio_mlp", 32, 16, False)]
+        jobs = [("mlp", w, horizon, False, 32) for w in mlp_widths]
+        jobs += [("lstm", 64, 16, False, 32),
+                 ("transformer_ring", 32, 16, False, 32),
+                 ("transformer_ring", 16, 16, False, 128),
+                 ("impala_lstm", 64, 16, False, 32),
+                 ("portfolio_mlp", 32, 16, False, 32)]
         args.iters = 2
     else:
         jobs = [
-            ("mlp", 1024, horizon, False),
-            ("mlp", 8192, horizon, True),    # sweet spot: split timed
-            ("mlp", 16384, horizon, True),
-            ("mlp", 32768, horizon, True),   # rollover row: split timed
-            ("lstm", 4096, horizon, False),
-            ("transformer_ring", 1024, horizon, False),
-            ("impala_lstm", 4096, horizon, False),
-            ("portfolio_mlp", 2048, horizon, False),
+            ("mlp", 1024, horizon, False, 32),
+            ("mlp", 8192, horizon, True, 32),    # sweet spot: split timed
+            ("mlp", 16384, horizon, True, 32),
+            ("mlp", 32768, horizon, True, 32),   # rollover row: split timed
+            ("lstm", 4096, horizon, False, 32),
+            ("transformer_ring", 1024, horizon, False, 32),
+            # long-context row: 8x the flagship window — the sequence
+            # length regime where ring attention's O(S/P) memory and the
+            # seq-parallel dryrun matter
+            ("transformer_ring", 256, horizon, False, 256),
+            ("impala_lstm", 4096, horizon, False, 32),
+            ("portfolio_mlp", 2048, horizon, False, 32),
         ]
 
     rows = []
-    for policy, n_envs, hor, split in jobs:
+    for policy, n_envs, hor, split, window in jobs:
         if policy == "portfolio_mlp":
-            trainer = _portfolio_trainer(n_envs, hor)
+            trainer = _portfolio_trainer(n_envs, hor, window)
         elif policy == "impala_lstm":
-            trainer = _impala_trainer(n_envs, hor)
+            trainer = _impala_trainer(n_envs, hor, window)
         else:
-            trainer = _single_pair_trainer(policy, n_envs, hor)
+            trainer = _single_pair_trainer(policy, n_envs, hor, window)
         sps, util, flops, split_out = _measure(
-            trainer, n_envs, hor, args.iters, split_rollout=split
+            trainer, n_envs, hor, args.iters, split_rollout=split,
+            profile_dir=(
+                Path(args.profile) / f"{policy}_{n_envs}"
+                if args.profile else None
+            ),
         )
         row = {
             "policy": policy,
             "n_envs": n_envs,
             "horizon": hor,
+            "window": window,
             "env_steps_per_sec_per_chip": round(sps, 1),
             "vs_baseline": round(sps / BASELINE_PER_CHIP, 3),
             "mfu": round(util, 5) if util is not None else None,
@@ -220,6 +245,15 @@ def main() -> int:
             "higher MFU"
         ),
     }
+    if any(r["window"] > 32 for r in rows):
+        notes["long_window_rows"] = (
+            "rows with window > 32 are LONG-CONTEXT capability "
+            "datapoints, not flagship-target configs: per-step attention "
+            "cost grows ~O(window^2) so steps/sec drops by design while "
+            "MFU RISES (the GEMMs finally dominate the env scan); the "
+            "multi-chip sequence-parallel path for these windows is "
+            "exercised by the ring/Ulysses dryrun and tests"
+        )
     split_rows = [r for r in rows if r.get("wall_split")]
     if len(split_rows) >= 2:
         segs = []
@@ -241,8 +275,30 @@ def main() -> int:
             "locality. Measured: " + "; ".join(segs)
         )
 
+    # headline = the flagship row (bench.py's exact configuration), so
+    # the committed artifact and the driver's bench.py line reconcile
+    # by construction
+    flagship = next(
+        (r for r in rows if r["policy"] == "mlp" and r["n_envs"] == 8192),
+        rows[0] if rows else None,
+    )
+    headline = None
+    if flagship:
+        headline = {
+            "metric": "ppo_env_steps_per_sec_per_chip",
+            "value": flagship["env_steps_per_sec_per_chip"],
+            "unit": "env steps/sec/chip (PPO MLP bf16 policy, fused "
+                    "rollout+update)",
+            "vs_baseline": flagship["vs_baseline"],
+            "mfu": flagship["mfu"],
+            "provenance": "the sweep's flagship row — bench.py's exact "
+                          "configuration (expect ~1% run-to-run variance "
+                          "between regenerations)",
+        }
+
     artifact = {
         "schema": "tpu_bench_sweep.v2",
+        "headline": headline,
         "notes": notes,
         "date_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(
             timespec="seconds"
