@@ -112,6 +112,41 @@ def test_venue_quantization_denies_below_min_quantity():
     assert float(s2.pos) == 0.5
 
 
+def test_crosscheck_reconciles_episode_with_denied_orders():
+    """An episode whose orders are sometimes DENIED by the venue size
+    rules still reconciles: the crosscheck's path builder detects the
+    denial from the recorded order_denied counter (r4: walk_pos/levels
+    come from recorded state, not from the assumption that every
+    pending order filled), and the replay venue denies the same orders
+    by the same min_quantity rule."""
+    from tests.helpers import make_df, make_env
+
+    rng = np.random.default_rng(7)
+    closes = 1.1 + np.cumsum(rng.normal(0, 2e-4, 60))
+    df = make_df(closes, highs=closes + 3e-4, lows=closes - 3e-4)
+    # position_size 0.5 with min_quantity 1: EVERY entry is denied;
+    # the decision stream still records the attempts
+    env = make_env(
+        df, position_size=0.5, venue_quantization=True,
+        min_quantity=1.0, size_precision=0,
+    )
+    actions = [1, 0, 2, 0, 1, 0, 0, 2, 0, 1] * 3
+    result = crosscheck_episode(dict(env.config), actions=actions, env=env)
+    assert result["within_bound"], result
+    assert result["scan_trades"] == 0          # nothing ever filled
+    assert result["replay_fills"] == 0         # replay denied them too
+
+    # mixed case: integral size fills, the venue denies nothing, and the
+    # recorded-state path builder agrees with the old inference
+    env2 = make_env(
+        df, position_size=1000.0, venue_quantization=True,
+        min_quantity=1.0, size_precision=0,
+    )
+    result2 = crosscheck_episode(dict(env2.config), actions=actions, env=env2)
+    assert result2["within_bound"], result2
+    assert result2["replay_fills"] > 0
+
+
 def test_venue_quantization_rounds_sizes_and_prices():
     from tests.helpers import make_df, make_env
 
