@@ -1,10 +1,11 @@
 #!/usr/bin/env python3
 """Regenerate EVERY committed TPU evidence artifact in one command.
 
-Runs the four generators in sequence (each is also runnable alone):
+Runs the artifact generators in sequence (each is also runnable alone):
 
   tools/tpu_bench.py          -> examples/results/tpu_bench_sweep.json
   tools/scan_bench.py         -> examples/results/tpu_scan_bench.json
+  tools/pallas_bench.py       -> examples/results/pallas_kernel_bench.json
   tools/train_to_sharpe.py    -> examples/results/tpu_train_to_sharpe.json
   tools/baseline_configs.py   -> examples/results/baseline_configs.json
 
@@ -29,6 +30,7 @@ GENERATORS = (
     ("bench.py", ["--quick"], []),
     ("tools/tpu_bench.py", ["--quick"], []),
     ("tools/scan_bench.py", ["--quick"], []),
+    ("tools/pallas_bench.py", ["--quick"], []),
     ("tools/train_to_sharpe.py", ["--quick"], []),
     # baseline_configs writes its artifact even under --quick: redirect
     # the smoke output so CI runs can never clobber committed evidence
