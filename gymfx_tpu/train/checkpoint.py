@@ -215,6 +215,11 @@ def _rebuild_like(template: Any, raw: Any, path: str = "") -> Any:
             for k, tv in template.items()
         }
     if isinstance(template, (list, tuple)):
+        if len(raw) != len(template):
+            raise ValueError(
+                f"checkpoint tree at {path or '<root>'} has "
+                f"{len(raw)} entries, expected {len(template)}"
+            )
         return type(template)(
             _rebuild_like(t, r, f"{path}[{i}]")
             for i, (t, r) in enumerate(zip(template, raw))

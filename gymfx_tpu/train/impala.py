@@ -337,6 +337,9 @@ class ImpalaTrainer:
                 learner_params=initial_params,
                 actor_params=jax.tree.map(jnp.copy, initial_params),
             )
+            if self.mesh is not None:
+                # restored host arrays must re-enter the mesh placement
+                state = self._shard_state(state)
         per_iter = self.icfg.n_envs * self.icfg.unroll
         iters = max(1, int(total_env_steps) // per_iter)
         t0 = time.perf_counter()

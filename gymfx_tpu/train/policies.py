@@ -342,6 +342,83 @@ class ContinuousMLPPolicy(nn.Module):
         return dist, value, carry
 
 
+class GaussianValueHead(nn.Module):
+    """Shared continuous actor-critic head: tanh-squashed Normal mean
+    over the Box(-1,1,(1,)) action, state-independent learned log-std,
+    and the value — the same distribution surface as
+    ContinuousMLPPolicy (kept separate there for checkpoint-structure
+    stability)."""
+
+    @nn.compact
+    def __call__(self, feat):
+        mu = nn.tanh(nn.Dense(1, dtype=jnp.float32)(feat))
+        log_std = self.param("log_std", nn.initializers.constant(-0.5), (1,))
+        value = nn.Dense(1, dtype=jnp.float32)(feat)
+        return (
+            (jnp.squeeze(mu, -1), jnp.broadcast_to(log_std[0], mu.shape[:-1])),
+            jnp.squeeze(value, -1),
+        )
+
+
+class ContinuousLSTMPolicy(nn.Module):
+    """Gaussian actor-critic on the recurrent trunk (continuous action
+    mode x BASELINE config 4's recurrent family)."""
+
+    hidden: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, carry):
+        x = x.astype(self.dtype)
+        x = nn.tanh(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        carry, x = nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype)(carry, x)
+        dist, value = GaussianValueHead()(x)
+        return dist, value, carry
+
+    def initial_carry(self, batch_shape=()):
+        return (
+            jnp.zeros((*batch_shape, self.hidden), dtype=self.dtype),
+            jnp.zeros((*batch_shape, self.hidden), dtype=self.dtype),
+        )
+
+    def apply_seq(self, params, x, carry):
+        return self.apply(params, x, carry)
+
+
+class ContinuousRingTransformerPolicy(nn.Module):
+    """Gaussian actor-critic over the shared RingTransformerEncoder —
+    serves continuous mode for every attention policy (transformer /
+    transformer_ring / transformer_ulysses), sequence-parallel modes
+    included (seq_sharded_forward works unchanged: same
+    window/seq_axis/seq_shards surface)."""
+
+    window: int = 32
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+    seq_shards: int = 1
+    sp_backend: str = "ring"
+
+    @nn.compact
+    def __call__(self, tokens):
+        pooled = RingTransformerEncoder(
+            window=self.window, d_model=self.d_model, n_heads=self.n_heads,
+            n_layers=self.n_layers, dtype=self.dtype,
+            seq_axis=self.seq_axis, seq_shards=self.seq_shards,
+            sp_backend=self.sp_backend,
+        )(tokens)
+        return GaussianValueHead()(pooled)
+
+    def initial_carry(self, batch_shape=()):
+        return ()
+
+    def apply_seq(self, params, tokens, carry):
+        dist, value = self.apply(params, tokens)
+        return dist, value, carry
+
+
 # policies whose inputs are (window, token_dim) token sequences rather
 # than flat vectors — shared by every trainer's encode/init paths
 TOKEN_POLICIES = ("transformer", "transformer_ring", "transformer_ulysses")
@@ -363,6 +440,14 @@ def policy_kwargs_for(name: str, kwargs: Dict[str, Any], window: int) -> Dict[st
 def make_policy(name: str, n_actions: int = 3, dtype: Any = jnp.float32, **kw):
     if name == "mlp_continuous":
         return ContinuousMLPPolicy(dtype=dtype, **kw)
+    if name == "lstm_continuous":
+        return ContinuousLSTMPolicy(dtype=dtype, **kw)
+    if name in ("transformer_continuous", "transformer_ring_continuous"):
+        return ContinuousRingTransformerPolicy(dtype=dtype, **kw)
+    if name == "transformer_ulysses_continuous":
+        return ContinuousRingTransformerPolicy(
+            dtype=dtype, sp_backend="ulysses", **kw
+        )
     if name == "mlp":
         return MLPPolicy(n_actions=n_actions, dtype=dtype, **kw)
     if name == "lstm":

@@ -106,14 +106,14 @@ class PPOTrainer:
         self.mesh = mesh
         self._continuous = env.cfg.action_space_mode == "continuous"
         if self._continuous:
-            if pcfg.policy != "mlp":
-                raise ValueError(
-                    "continuous action mode currently supports the mlp "
-                    f"policy (got {pcfg.policy!r})"
-                )
+            # every policy family has a Gaussian twin: <name>_continuous
+            # (train/policies.py — the attention family shares one
+            # RingTransformerEncoder-based module)
+            kw = dict(pcfg.policy_kwargs)
+            if is_token_policy(pcfg.policy):
+                kw.setdefault("window", env.cfg.window_size)
             self.policy = make_policy(
-                "mlp_continuous", dtype=pcfg.policy_dtype,
-                **dict(pcfg.policy_kwargs)
+                f"{pcfg.policy}_continuous", dtype=pcfg.policy_dtype, **kw
             )
         else:
             self.policy = make_policy(
@@ -431,6 +431,10 @@ class PPOTrainer:
             state = self.init_state(seed)
         if initial_params is not None:
             state = state._replace(params=initial_params)
+            if self.mesh is not None:
+                # restored host arrays must re-enter the mesh placement
+                # (model-axis tensor sharding), like the full-state path
+                state = self._shard_state(state)
         steps_per_iter = self.pcfg.n_envs * self.pcfg.horizon
         iters = max(1, int(total_env_steps) // steps_per_iter)
         t0 = time.perf_counter()

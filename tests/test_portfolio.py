@@ -312,6 +312,53 @@ def test_sweep_mode_preflight_uses_banked_realized(tmp_path):
     assert int(swept["blocked_margin"]) == 1
 
 
+def test_portfolio_full_state_resume_continues_exact_trajectory(tmp_path):
+    """r4: the portfolio trainer joins PPO/IMPALA's true-resume contract
+    — a run restored from the composite checkpoint produces the SAME
+    trajectory as the uninterrupted run (opt moments, env batch, RNG)."""
+    import jax
+
+    from gymfx_tpu.train.checkpoint import (
+        load_params,
+        load_train_state,
+        save_checkpoint,
+    )
+    from gymfx_tpu.train.portfolio_ppo import (
+        PortfolioPPOConfig,
+        PortfolioPPOTrainer,
+        PortfolioTrainState,
+    )
+
+    env = _env(window_size=8)
+    tr = PortfolioPPOTrainer(
+        env, PortfolioPPOConfig(n_envs=4, horizon=8, epochs=1, minibatches=2)
+    )
+    s = tr.init_state(0)
+    for _ in range(2):
+        s, _ = tr.train_step(s)
+    save_checkpoint(str(tmp_path / "ck"), s._asdict(), step=2, params=s.params)
+
+    s_res, warm, step = load_train_state(
+        str(tmp_path / "ck"), tr, PortfolioTrainState
+    )
+    assert step == 2 and warm is None and s_res is not None
+    # the params item restores standalone (evaluation path)
+    p_only, _ = load_params(str(tmp_path / "ck"))
+    for a, b in zip(jax.tree.leaves(s.params), jax.tree.leaves(p_only)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    s_cont = s
+    for _ in range(2):
+        s_cont, m_cont = tr.train_step(s_cont)
+        s_res, m_res = tr.train_step(s_res)
+    for a, b in zip(jax.tree.leaves(s_cont.params), jax.tree.leaves(s_res.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree.leaves(s_cont.opt_state), jax.tree.leaves(s_res.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_portfolio_cli_training(tmp_path):
     import json
 

@@ -47,6 +47,23 @@ def test_policy_families_train(policy):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.parametrize("policy", ["mlp", "lstm", "transformer_ring"])
+def test_continuous_mode_supports_every_policy_family(policy):
+    """r4: continuous action mode is no longer MLP-only — each family
+    gets a Gaussian twin (train/policies.py <name>_continuous) and
+    trains + evaluates greedily through the same PPO machinery."""
+    kwargs = {"hidden": [32, 32]} if policy == "mlp" else {}
+    tr = _trainer(policy=policy, policy_kwargs=kwargs,
+                  action_space_mode="continuous", num_envs=4, ppo_horizon=8)
+    assert tr._continuous
+    s = tr.init_state(0)
+    s, metrics = tr.train_step(s)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["entropy"]))
+    summary = evaluate(tr, s.params, steps=30)
+    assert np.isfinite(summary["final_equity"])
+
+
 def test_ppo_learns_to_go_long_on_strong_uptrend():
     # Overwhelming signal: strict uptrend, large position, amplified reward.
     tr = _trainer(
@@ -537,10 +554,54 @@ def test_continuous_action_ppo_trains_and_learns():
     assert summary["total_return"] > 0, summary
 
 
-def test_continuous_rejects_non_mlp_policy():
-    with pytest.raises(ValueError, match="continuous action"):
-        _trainer(action_space_mode="continuous", policy="lstm",
-                 policy_kwargs={})
+def test_params_only_warm_start_is_resharded_on_mesh(tmp_path):
+    """A legacy params-only checkpoint restored onto a mesh trainer must
+    re-enter the mesh placement (model-axis tensor sharding), exactly
+    like the full-state resume path (r4 review finding)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.data.feed import MarketDataset
+    from gymfx_tpu.parallel import make_mesh
+    from gymfx_tpu.train.checkpoint import load_train_state, save_checkpoint
+    from gymfx_tpu.train.ppo import TrainState
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=8, ppo_horizon=8,
+                  ppo_epochs=1, ppo_minibatches=2,
+                  policy_kwargs={"hidden": [256, 256]})
+    env = Environment(config, dataset=MarketDataset(uptrend_df(120), config))
+    mesh = make_mesh({"data": 2, "model": 2})
+    tr = PPOTrainer(env, ppo_config_from(config), mesh=mesh)
+
+    donor = tr.init_state_from_key(jax.random.PRNGKey(5))
+    save_checkpoint(str(tmp_path / "ck"), donor.params, step=1,
+                    metadata={"state_format": "params"})
+    state, warm, step = load_train_state(str(tmp_path / "ck"), tr, TrainState)
+    assert state is None and warm is not None
+
+    out_state, _ = tr.train(total_env_steps=64, initial_params=warm)
+    wide = [
+        x for x in jax.tree.leaves(out_state.params)
+        if getattr(x, "ndim", 0) == 2 and x.shape[-1] == 256
+    ]
+    assert wide, "expected wide kernels in the policy"
+    assert any(
+        x.sharding.spec == P(None, "model") for x in wide
+    ), [x.sharding for x in wide]
+
+
+def test_continuous_unknown_policy_fails_loudly():
+    """Continuous mode now covers every policy family (r4,
+    test_continuous_mode_supports_every_policy_family); a policy name
+    without a Gaussian twin still fails at construction, not as an
+    opaque trace error."""
+    from gymfx_tpu.train.policies import make_policy
+
+    with pytest.raises(ValueError, match="unknown policy"):
+        make_policy("nonexistent_continuous")
 
 
 def test_ppo_lstm_stored_state_replay_is_exact():
