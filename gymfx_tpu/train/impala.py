@@ -34,9 +34,11 @@ from gymfx_tpu.core.runtime import Environment
 from gymfx_tpu.train.common import masked_reset
 from gymfx_tpu.train.policies import (
     flatten_obs,
+    gaussian_entropy,
     is_token_policy,
-    make_policy,
-    policy_kwargs_for,
+    make_trainer_policy,
+    normal_logp,
+    sample_normal,
     tokens_from_obs,
 )
 
@@ -97,11 +99,14 @@ class ImpalaTrainer:
         self.env = env
         self.icfg = icfg
         self.mesh = mesh
-        self.policy = make_policy(
-            icfg.policy, dtype=icfg.policy_dtype,
-            **policy_kwargs_for(
-                icfg.policy, dict(icfg.policy_kwargs), env.cfg.window_size
-            ),
+        # V-trace is distribution-agnostic: continuous mode swaps in the
+        # Gaussian twin via the shared construction path (only the
+        # log-prob and entropy terms change, train/policies.py)
+        self._continuous = env.cfg.action_space_mode == "continuous"
+        self.policy = make_trainer_policy(
+            icfg.policy, continuous=self._continuous,
+            dtype=icfg.policy_dtype, kwargs=dict(icfg.policy_kwargs),
+            window=env.cfg.window_size,
         )
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(icfg.max_grad_norm),
@@ -182,15 +187,23 @@ class ImpalaTrainer:
         carry0 = self.policy.initial_carry(())
         reset_state, reset_vec = self._reset_state, self._reset_vec
 
+        continuous = self._continuous
+
         def body(carry, _):
             env_states, obs_vec, pcarry, rng = carry
             rng, k = jax.random.split(rng)
-            logits, _value, pcarry2 = fwd(actor_params, obs_vec, pcarry)
-            keys = jax.random.split(k, logits.shape[0])
-            action = jax.vmap(jax.random.categorical)(keys, logits)
-            logp = jnp.take_along_axis(
-                jax.nn.log_softmax(logits), action[:, None], axis=1
-            )[:, 0]
+            dist, _value, pcarry2 = fwd(actor_params, obs_vec, pcarry)
+            if continuous:
+                mu, log_std = dist
+                action = sample_normal(k, dist)
+                logp = normal_logp(action, mu, log_std)
+            else:
+                logits = dist
+                keys = jax.random.split(k, logits.shape[0])
+                action = jax.vmap(jax.random.categorical)(keys, logits)
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits), action[:, None], axis=1
+                )[:, 0]
             env_states2, obs2, reward, done, _ = vstep(
                 cfg, eparams, data, env_states, action
             )
@@ -256,20 +269,26 @@ class ImpalaTrainer:
         return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
 
     def _loss(self, params, traj, init_carry, final_obs_vec):
-        logits, values, bootstrap = self._learner_replay(
+        dist, values, bootstrap = self._learner_replay(
             params, traj, init_carry, final_obs_vec
         )
-        logp_all = jax.nn.log_softmax(logits)
-        pi_logp = jnp.take_along_axis(
-            logp_all, traj["action"][..., None], axis=-1
-        )[..., 0]
+        if self._continuous:
+            mu, log_std = dist
+            pi_logp = normal_logp(traj["action"], mu, log_std)
+            entropy = gaussian_entropy(log_std)
+        else:
+            logits = dist
+            logp_all = jax.nn.log_softmax(logits)
+            pi_logp = jnp.take_along_axis(
+                logp_all, traj["action"][..., None], axis=-1
+            )[..., 0]
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
         rhos = jnp.exp(pi_logp - traj["mu_logp"])
         vs, pg_adv = self._vtrace(
             values, bootstrap, traj["reward"], traj["done"], rhos
         )
         policy_loss = -jnp.mean(pi_logp * pg_adv)
         value_loss = 0.5 * jnp.mean((vs - values) ** 2)
-        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
         total = (
             policy_loss
             + self.icfg.vf_coef * value_loss
@@ -419,4 +438,4 @@ class _EvalShim:
         self._encode = trainer._encode
         self._policy_forward = trainer._forward
         self._greedy_driver = None
-        self._continuous = False  # IMPALA trains discrete policies
+        self._continuous = trainer._continuous

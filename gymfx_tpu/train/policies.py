@@ -330,7 +330,11 @@ class ContinuousMLPPolicy(nn.Module):
         for width in self.hidden:
             x = nn.tanh(nn.Dense(width, dtype=self.dtype)(x))
         mu = nn.tanh(nn.Dense(1, dtype=jnp.float32)(x))
-        log_std = self.param("log_std", nn.initializers.constant(-0.5), (1,))
+        # explicit f32: a default-dtype param turns f64 under x64 test
+        # configs and promotes actions/log-probs downstream
+        log_std = self.param(
+            "log_std", nn.initializers.constant(-0.5), (1,), jnp.float32
+        )
         value = nn.Dense(1, dtype=jnp.float32)(x)
         return (jnp.squeeze(mu, -1), jnp.broadcast_to(log_std[0], mu.shape[:-1])), jnp.squeeze(value, -1)
 
@@ -340,6 +344,52 @@ class ContinuousMLPPolicy(nn.Module):
     def apply_seq(self, params, x, carry):
         dist, value = self.apply(params, x)
         return dist, value, carry
+
+
+# ---------------------------------------------------------------------------
+# Gaussian action distribution helpers — ONE definition for every trainer
+# (PPO ratio/entropy, IMPALA V-trace importance weights).  Constants are
+# cast to the input dtype: weakly-typed Python floats (and default-dtype
+# random sampling) turn f64 under x64 test configs and flip scan-carry
+# dtypes downstream.
+# ---------------------------------------------------------------------------
+HALF_LOG_2PI = 0.9189385332046727        # 0.5 * ln(2*pi)
+GAUSS_ENTROPY_CONST = 1.4189385332046727  # 0.5 * ln(2*pi*e)
+
+
+def normal_logp(x, mu, log_std):
+    """Gaussian log-prob in the INPUT dtype."""
+    std = jnp.exp(log_std)
+    const = jnp.asarray(HALF_LOG_2PI, x.dtype)
+    return -0.5 * ((x - mu) / std) ** 2 - log_std - const
+
+
+def sample_normal(key, dist):
+    """Reparameterized sample from a (mu, log_std) pair, in mu's dtype."""
+    import jax as _jax
+
+    mu, log_std = dist
+    return mu + jnp.exp(log_std) * _jax.random.normal(key, mu.shape, mu.dtype)
+
+
+def gaussian_entropy(log_std):
+    """Mean differential entropy of the (diagonal) Normal."""
+    return jnp.mean(jnp.asarray(GAUSS_ENTROPY_CONST, log_std.dtype) + log_std)
+
+
+def make_trainer_policy(name: str, *, continuous: bool, dtype: Any,
+                        kwargs: Dict[str, Any], window: int):
+    """The one policy-construction path shared by the trainers: resolves
+    per-family kwargs (ring policies need the global window) and picks
+    the Gaussian twin (``<name>_continuous``) in continuous mode —
+    token-policy twins also need the window for their positional
+    embeddings."""
+    kw = policy_kwargs_for(name, dict(kwargs), window)
+    if continuous:
+        if is_token_policy(name):
+            kw.setdefault("window", window)
+        return make_policy(f"{name}_continuous", dtype=dtype, **kw)
+    return make_policy(name, dtype=dtype, **kw)
 
 
 class GaussianValueHead(nn.Module):
@@ -352,7 +402,10 @@ class GaussianValueHead(nn.Module):
     @nn.compact
     def __call__(self, feat):
         mu = nn.tanh(nn.Dense(1, dtype=jnp.float32)(feat))
-        log_std = self.param("log_std", nn.initializers.constant(-0.5), (1,))
+        # explicit f32 (see ContinuousMLPPolicy: x64 would promote it)
+        log_std = self.param(
+            "log_std", nn.initializers.constant(-0.5), (1,), jnp.float32
+        )
         value = nn.Dense(1, dtype=jnp.float32)(feat)
         return (
             (jnp.squeeze(mu, -1), jnp.broadcast_to(log_std[0], mu.shape[:-1])),

@@ -30,9 +30,11 @@ from gymfx_tpu.core.runtime import Environment
 from gymfx_tpu.train.common import masked_reset
 from gymfx_tpu.train.policies import (
     flatten_obs,
+    gaussian_entropy,
     is_token_policy,
-    make_policy,
-    policy_kwargs_for,
+    make_trainer_policy,
+    normal_logp,
+    sample_normal,
     tokens_from_obs,
 )
 
@@ -79,13 +81,9 @@ def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
     )
 
 
-def _normal_logp(x, mu, log_std):
-    std = jnp.exp(log_std)
-    return (
-        -0.5 * ((x - mu) / std) ** 2
-        - log_std
-        - 0.5 * jnp.log(2.0 * jnp.pi)
-    )
+# one shared definition of the Gaussian distribution helpers
+# (train/policies.py); the local alias keeps this module's call sites
+_normal_logp = normal_logp
 
 
 class TrainState(NamedTuple):
@@ -105,23 +103,11 @@ class PPOTrainer:
         self.pcfg = pcfg
         self.mesh = mesh
         self._continuous = env.cfg.action_space_mode == "continuous"
-        if self._continuous:
-            # every policy family has a Gaussian twin: <name>_continuous
-            # (train/policies.py — the attention family shares one
-            # RingTransformerEncoder-based module)
-            kw = dict(pcfg.policy_kwargs)
-            if is_token_policy(pcfg.policy):
-                kw.setdefault("window", env.cfg.window_size)
-            self.policy = make_policy(
-                f"{pcfg.policy}_continuous", dtype=pcfg.policy_dtype, **kw
-            )
-        else:
-            self.policy = make_policy(
-                pcfg.policy, dtype=pcfg.policy_dtype,
-                **policy_kwargs_for(
-                    pcfg.policy, dict(pcfg.policy_kwargs), env.cfg.window_size
-                ),
-            )
+        self.policy = make_trainer_policy(
+            pcfg.policy, continuous=self._continuous,
+            dtype=pcfg.policy_dtype, kwargs=dict(pcfg.policy_kwargs),
+            window=env.cfg.window_size,
+        )
         self.optimizer = self._make_optimizer()
 
         cfg, params, data = env.cfg, env.params, env.data
@@ -243,8 +229,7 @@ class PPOTrainer:
             dist, value, pcarry2 = fwd(params, obs_vec, pcarry)
             if continuous:
                 mu, log_std = dist
-                std = jnp.exp(log_std)
-                action = mu + std * jax.random.normal(k, mu.shape)
+                action = sample_normal(k, dist)
                 logp = _normal_logp(action, mu, log_std)
             else:
                 logits = dist
@@ -312,7 +297,7 @@ class PPOTrainer:
         if self._continuous:
             mu, log_std = dist
             logp = _normal_logp(batch["action"], mu, log_std)
-            entropy = jnp.mean(0.5 * jnp.log(2 * jnp.pi * jnp.e) + log_std)
+            entropy = gaussian_entropy(log_std)
         else:
             logits = dist
             logp_all = jax.nn.log_softmax(logits)

@@ -47,6 +47,30 @@ def test_policy_families_train(policy):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.parametrize("policy", ["mlp", "lstm"])
+def test_impala_continuous_mode(policy):
+    """r4: IMPALA's V-trace is distribution-agnostic — the Gaussian
+    twins serve the actor-learner too (importance weights from Normal
+    log-probs)."""
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.data.feed import MarketDataset
+    from gymfx_tpu.train.impala import ImpalaTrainer, impala_config_from
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=4,
+                  impala_unroll=8, action_space_mode="continuous",
+                  policy=policy, policy_kwargs={})
+    env = Environment(config, dataset=MarketDataset(uptrend_df(80), config))
+    tr = ImpalaTrainer(env, impala_config_from(config))
+    assert tr._continuous
+    s = tr.init_state(0)
+    s, metrics = tr.train_step(s)
+    for key in ("loss", "entropy", "mean_rho"):
+        assert np.isfinite(float(metrics[key])), key
+    # on-policy first step: importance ratios hover around 1
+    assert 0.2 < float(metrics["mean_rho"]) < 5.0
+
+
 @pytest.mark.parametrize("policy", ["mlp", "lstm", "transformer_ring"])
 def test_continuous_mode_supports_every_policy_family(policy):
     """r4: continuous action mode is no longer MLP-only — each family
