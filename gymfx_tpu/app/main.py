@@ -123,6 +123,12 @@ def run_mode(config: Dict[str, Any]) -> Dict[str, Any]:
 
         return optimize_from_config(config)
     if config.get("driver_mode") == "policy":
+        if config.get("portfolio_files"):
+            from gymfx_tpu.train.portfolio_ppo import (
+                eval_portfolio_policy_from_config,
+            )
+
+            return eval_portfolio_policy_from_config(config)
         from gymfx_tpu.train.ppo import eval_policy_from_config
 
         return eval_policy_from_config(config)

@@ -116,6 +116,51 @@ def labeled_eval_summary(make_summary, train_env, eval_env) -> Dict[str, Any]:
     return summary
 
 
+def eval_checkpointed_policy(
+    config: Dict[str, Any],
+    *,
+    build_envs,
+    make_trainer,
+    evaluate_fn,
+    resolve_policy=None,
+    validate=None,
+) -> Dict[str, Any]:
+    """The one ``driver_mode=policy`` skeleton shared by the single-pair
+    and portfolio paths: checkpoint-dir guard, metadata honor
+    (``resolve_policy(meta, config)`` mutates the config copy),
+    train/eval env build, template-validated params restore, greedy
+    evaluation, and the labeled summary keys.  ``validate(meta, env)``
+    rejects checkpoint/config mismatches loudly (e.g. portfolio pair
+    sets)."""
+    import jax
+
+    ckpt_dir = config.get("checkpoint_dir")
+    if not ckpt_dir:
+        raise ValueError("driver_mode=policy requires checkpoint_dir")
+    from gymfx_tpu.train.checkpoint import load_params, read_metadata
+
+    meta = read_metadata(str(ckpt_dir))
+    config = dict(config)
+    if resolve_policy is not None:
+        resolve_policy(meta, config)
+    train_env, eval_env = build_envs(config)
+    env = eval_env if eval_env is not None else train_env
+    if validate is not None:
+        validate(meta, env)
+    trainer = make_trainer(env, config)
+    # template-validated restore: an architecture mismatch fails loudly
+    # at load time, not as an opaque shape error inside the episode scan
+    template = jax.eval_shape(
+        lambda k: trainer.init_state_from_key(k).params, jax.random.PRNGKey(0)
+    )
+    params, step = load_params(str(ckpt_dir), template=template)
+    summary = evaluate_fn(trainer, params, config.get("steps"))
+    summary["checkpoint_step"] = step
+    summary["eval_scope"] = "held_out" if eval_env is not None else "in_sample"
+    summary["mode"] = "inference"
+    return summary
+
+
 def reject_eval_keys(config: Dict[str, Any], trainer_name: str) -> None:
     """Honor-or-reject: trainers without held-out evaluation machinery
     must refuse the out-of-sample keys rather than silently reporting

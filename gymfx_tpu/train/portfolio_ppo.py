@@ -459,6 +459,40 @@ def evaluate(trainer: "PortfolioPPOTrainer", params,
     return summary
 
 
+def eval_portfolio_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """CLI ``driver_mode=policy`` with ``portfolio_files``: greedy
+    evaluation of a checkpointed portfolio policy via the shared
+    skeleton (train/common.py eval_checkpointed_policy), with the
+    pair-set checked against the checkpoint (positional heads)."""
+    from gymfx_tpu.train.common import (
+        build_portfolio_train_eval_envs,
+        eval_checkpointed_policy,
+    )
+
+    def resolve(meta, cfg):
+        stored = str(meta.get("policy") or "")
+        if not cfg.get("policy") and stored.startswith("portfolio_"):
+            cfg["policy"] = stored[len("portfolio_"):]
+
+    def validate(meta, env):
+        if meta.get("pairs") and list(meta["pairs"]) != list(env.pairs):
+            raise ValueError(
+                f"checkpoint was trained on pairs {meta['pairs']}, config "
+                f"loads {env.pairs} — the per-pair heads are positional"
+            )
+
+    return eval_checkpointed_policy(
+        config,
+        build_envs=build_portfolio_train_eval_envs,
+        make_trainer=lambda env, cfg: PortfolioPPOTrainer(
+            env, PortfolioPPOConfig(policy=str(cfg.get("policy") or "mlp"))
+        ),
+        evaluate_fn=lambda tr, params, steps: evaluate(tr, params, steps=steps),
+        resolve_policy=resolve,
+        validate=validate,
+    )
+
+
 def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.train.common import (
         build_portfolio_train_eval_envs,

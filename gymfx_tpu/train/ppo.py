@@ -503,38 +503,26 @@ def _step_sharpe(equity: np.ndarray, timeframe_hours: float) -> Optional[float]:
 
 def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     """CLI driver_mode=policy: load the checkpointed policy and run a
-    greedy evaluation episode."""
-    ckpt_dir = config.get("checkpoint_dir")
-    if not ckpt_dir:
-        raise ValueError("driver_mode=policy requires checkpoint_dir")
-    from gymfx_tpu.train.checkpoint import load_params, read_metadata
-
-    # the checkpoint records which policy architecture produced it; honor
-    # that unless the user explicitly overrides --policy
-    meta = read_metadata(str(ckpt_dir))
-    config = dict(config)
-    if not config.get("policy") and meta.get("policy"):
-        config["policy"] = meta["policy"]
-        config.setdefault("policy_kwargs", meta.get("policy_kwargs") or {})
-
-    # honor the out-of-sample keys: with eval_split/eval_data_file set,
-    # the checkpointed policy is evaluated on the HELD-OUT bars (the
-    # split a prior training run used), not the full training file
-    from gymfx_tpu.train.common import build_train_eval_envs
-
-    train_env, eval_env = build_train_eval_envs(config)
-    env = eval_env if eval_env is not None else train_env
-    trainer = PPOTrainer(env, ppo_config_from(config))
-    # template-validated restore: an architecture mismatch fails loudly
-    # at load time, not as an opaque shape error inside the episode scan
-    template = jax.eval_shape(
-        lambda k: trainer.init_state_from_key(k).params, jax.random.PRNGKey(0)
+    greedy evaluation episode (shared skeleton:
+    train/common.py eval_checkpointed_policy — honors the checkpoint's
+    recorded architecture and the out-of-sample keys)."""
+    from gymfx_tpu.train.common import (
+        build_train_eval_envs,
+        eval_checkpointed_policy,
     )
-    params, step = load_params(str(ckpt_dir), template=template)
-    summary = evaluate(trainer, params, steps=config.get("steps"))
-    summary["checkpoint_step"] = step
-    summary["eval_scope"] = "held_out" if eval_env is not None else "in_sample"
-    return summary
+
+    def resolve(meta, cfg):
+        if not cfg.get("policy") and meta.get("policy"):
+            cfg["policy"] = meta["policy"]
+            cfg.setdefault("policy_kwargs", meta.get("policy_kwargs") or {})
+
+    return eval_checkpointed_policy(
+        config,
+        build_envs=build_train_eval_envs,
+        make_trainer=lambda env, cfg: PPOTrainer(env, ppo_config_from(cfg)),
+        evaluate_fn=lambda tr, params, steps: evaluate(tr, params, steps=steps),
+        resolve_policy=resolve,
+    )
 
 
 def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:

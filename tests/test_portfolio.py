@@ -359,6 +359,54 @@ def test_portfolio_full_state_resume_continues_exact_trajectory(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_portfolio_policy_eval_cli_roundtrip(tmp_path):
+    """r4: driver_mode=policy works for portfolio checkpoints — train
+    via the CLI (composite checkpoint), then evaluate the checkpointed
+    policy greedily through the same CLI, honoring eval_split."""
+    import json
+
+    from gymfx_tpu.app.main import main
+
+    ck = tmp_path / "ck"
+    cfg = tmp_path / "pcfg.json"
+    cfg.write_text(json.dumps({"portfolio_files": FILES}))
+    main([
+        "--mode", "training", "--trainer", "portfolio",
+        "--num_envs", "4", "--train_total_steps", "64",
+        "--ppo_horizon", "8", "--window_size", "8",
+        "--checkpoint_dir", str(ck), "--quiet_mode",
+        "--results_file", str(tmp_path / "train.json"),
+        "--load_config", str(cfg),
+    ])
+    s = main([
+        "--driver_mode", "policy", "--checkpoint_dir", str(ck),
+        "--window_size", "8", "--eval_split", "0.25", "--quiet_mode",
+        "--results_file", str(tmp_path / "eval.json"),
+        "--load_config", str(cfg),
+    ])
+    assert s["mode"] == "inference"
+    assert s["eval_scope"] == "held_out"
+    assert s["pairs"] == list(FILES)
+    assert np.isfinite(s["final_equity"])
+    assert s["checkpoint_step"] == 64
+
+    # pair-set mismatch fails loudly (positional per-pair heads)
+    import pytest as _pytest
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(
+        {"portfolio_files": {"EUR_USD": FILES["EUR_USD"],
+                             "GBP_USD": FILES["GBP_USD"]}}
+    ))
+    with _pytest.raises(ValueError, match="positional"):
+        main([
+            "--driver_mode", "policy", "--checkpoint_dir", str(ck),
+            "--window_size", "8", "--quiet_mode",
+            "--results_file", str(tmp_path / "bad_eval.json"),
+            "--load_config", str(bad),
+        ])
+
+
 def test_portfolio_cli_training(tmp_path):
     import json
 
