@@ -269,10 +269,13 @@ def main() -> int:
         notes["batch_width_rollover"] = (
             "wider-than-sweet-spot rows are slower because the UPDATE "
             "phase degrades super-linearly while the rollout scales "
-            "near-linearly — the per-epoch random-permutation minibatch "
-            "gather over the (horizon*n_envs, obs) buffer goes "
-            "HBM-bandwidth-bound once the buffer outgrows on-chip "
-            "locality. Measured: " + "; ".join(segs)
+            "near-linearly: per-sample update cost rises as the "
+            "(horizon*n_envs, obs) buffers outgrow on-chip locality and "
+            "the minibatch forward/backward streams activations from "
+            "HBM with less reuse (the permutation gather itself "
+            "measures <1% of the update at 8192 envs — it is the "
+            "fwd/bwd traffic, not the shuffle). Measured: "
+            + "; ".join(segs)
         )
 
     # headline = the flagship row (bench.py's exact configuration), so
