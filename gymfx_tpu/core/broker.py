@@ -62,6 +62,24 @@ def quantize(x, tick):
     return jnp.where(tick > 0, jnp.round(x / safe) * safe, x)
 
 
+def snap_in_bar(price, low, high, tick):
+    """Clip ``price`` into the bar's [low, high], then snap to the
+    nearest IN-BAR venue tick, so ``apply_fill``'s round-half-even
+    re-quantization is an identity and slip_match's in-range guarantee
+    survives venue quantization (ADVICE r4).  Each one-tick correction
+    only fires when it LANDS in-bar: a bar narrower than one tick
+    (off-grid H/L, a data/venue inconsistency) keeps the nearest tick —
+    the best on-grid price that exists — instead of oscillating.
+    Identity when tick == 0 (quantization off)."""
+    p = jnp.clip(price, low, high)
+    q = quantize(p, tick)
+    t = jnp.asarray(tick, q.dtype)
+    down, up = q - t, q + t
+    q = jnp.where((q > high) & (down >= low), down, q)
+    q = jnp.where((q < low) & (up <= high), up, q)
+    return q
+
+
 def opening_units(pos, target):
     """Units newly opened by moving ``pos`` -> ``target``: the size
     increase when flat/adding, the whole new position on a flip.
@@ -243,7 +261,7 @@ def fill_pending(
             1.0 + params.slippage * (1.0 if slip_open else 0.0) * direction
         )
         if slip_match:
-            final = jnp.clip(final, low, high)
+            final = snap_in_bar(final, low, high, params.price_tick)
         denom = 1.0 + params.slippage * direction
         fill_price = final / jnp.where(denom == 0, 1.0, denom)
     new_state = apply_fill(state, fill_price, target, params)
@@ -363,12 +381,12 @@ def check_brackets(
         sl_scale = jnp.where(sl_gap, 1.0 if cfg.slip_open else 0.0, 1.0)
         sl_final = sl_fill * (1.0 + params.slippage * sl_scale * exit_dir)
         if cfg.slip_match:
-            sl_final = jnp.clip(sl_final, low, high)
+            sl_final = snap_in_bar(sl_final, low, high, params.price_tick)
         sl_adj = sl_final / safe_denom
     if cfg.slip_limit:
         tp_final = tp_fill * (1.0 + params.slippage * exit_dir)
         if cfg.slip_match:
-            tp_final = jnp.clip(tp_final, low, high)
+            tp_final = snap_in_bar(tp_final, low, high, params.price_tick)
         # a limit never fills worse than its price (cap applied last)
         tp_final = jnp.where(
             long, jnp.maximum(tp_final, tp), jnp.minimum(tp_final, tp)

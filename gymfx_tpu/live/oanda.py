@@ -124,18 +124,33 @@ class OandaLiveBroker:
     def market_order(self, instrument: str, units: float, *,
                      stop_loss: Optional[float] = None,
                      take_profit: Optional[float] = None,
-                     price_precision: int = 5) -> Dict[str, Any]:
+                     price_precision: int = 5,
+                     client_id: Optional[str] = None) -> Dict[str, Any]:
         """Market order for signed ``units``; brackets attach as
-        on-fill orders (the scan engine's entry-with-brackets flow)."""
-        if units == 0:
-            raise ValueError("market_order requires nonzero units")
+        on-fill orders (the scan engine's entry-with-brackets flow).
+
+        ``units`` is rounded to the nearest integer (OANDA units are
+        integral); an order that rounds to zero is refused loudly rather
+        than silently dropped.  ``client_id`` becomes the order's
+        ``clientExtensions.id`` — OANDA rejects a duplicate client id,
+        so a deterministic id per decision makes a retry after a
+        transport timeout surface as an API error instead of a second
+        fill."""
+        int_units = int(round(float(units)))
+        if int_units == 0:
+            raise ValueError(
+                f"market_order units {units!r} round to zero — OANDA "
+                "units are integral; refuse rather than silently no-op"
+            )
         order: Dict[str, Any] = {
             "type": "MARKET",
             "instrument": instrument,
-            "units": str(int(units)),
+            "units": str(int_units),
             "timeInForce": "FOK",
             "positionFill": "DEFAULT",
         }
+        if client_id:
+            order["clientExtensions"] = {"id": str(client_id)}
         if stop_loss:
             order["stopLossOnFill"] = {
                 "price": f"{stop_loss:.{price_precision}f}"
@@ -148,6 +163,24 @@ class OandaLiveBroker:
             "POST", f"/v3/accounts/{self.account_id}/orders",
             {"order": order},
         )
+
+    def order_by_client_id(self, client_id: str) -> Optional[Dict[str, Any]]:
+        """The order previously submitted with ``clientExtensions.id``
+        ``client_id`` in ANY state (pending, filled, cancelled), or
+        ``None`` when the account has never seen that id — OANDA's
+        ``@``-prefixed orderSpecifier lookup."""
+        from urllib.parse import quote
+
+        try:
+            return self._request(
+                "GET",
+                f"/v3/accounts/{self.account_id}/orders/"
+                f"@{quote(str(client_id), safe='')}",
+            ).get("order")
+        except OandaApiError as e:
+            if e.status == 404:
+                return None
+            raise
 
     def close_position(self, instrument: str) -> Dict[str, Any]:
         """Flatten the instrument (both sides, like the scan engine's
@@ -167,25 +200,75 @@ class TargetOrderRouter:
     engine re-executes.  ``submit_target`` turns one decision into the
     minimal OANDA action: the units DELTA as a market order (with
     brackets on opening orders), or a position close when the target is
-    flat.  Idempotent on no-ops (target == current)."""
+    flat.  Idempotent on no-ops (target == current).
+
+    Retry safety: positions are reconciled (re-read) on every call, so
+    a retry after the server accepted the previous order recomputes a
+    zero delta once the fill is visible.  For the window before it is
+    visible, every order carries a ``clientExtensions`` id, and when
+    the caller supplies a ``decision_id`` (the bar index / timestamp of
+    the decision) the router LOOKS UP that id on the account before
+    submitting — OANDA's ``@client-id`` orderSpecifier finds the order
+    in any state, including already-filled FOK market orders, so a
+    blind resubmit of the same decision returns the original order
+    instead of double-filling.  (The id alone is not enough: OANDA only
+    enforces client-id uniqueness among PENDING orders, and a filled
+    market order is no longer pending.)  Without an explicit
+    ``decision_id`` the router falls back to a session-unique uuid-
+    salted sequence — unique, but NOT retry-safe across callers:
+    duplicate-order protection requires the caller's ``decision_id``.
+
+    Units contract: live OANDA units are integral.  A fractional
+    ``target_units`` (beyond float noise) is refused loudly — sizing
+    kernels that emit sub-unit targets must be scaled before routing
+    live, never silently under-traded."""
 
     def __init__(self, broker: OandaLiveBroker, instrument: str, *,
-                 price_precision: int = 5):
+                 price_precision: int = 5,
+                 client_id_prefix: str = "gymfx"):
         self.broker = broker
         self.instrument = instrument
         self.price_precision = int(price_precision)
+        self.client_id_prefix = str(client_id_prefix)
+        import uuid
+
+        self._session_tag = uuid.uuid4().hex[:8]
+        self._decision_seq = 0
 
     def submit_target(self, target_units: float, *,
                       stop_loss: Optional[float] = None,
-                      take_profit: Optional[float] = None) -> Optional[Dict[str, Any]]:
+                      take_profit: Optional[float] = None,
+                      decision_id: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        rounded_target = round(float(target_units))
+        if abs(float(target_units) - rounded_target) > 1e-6:
+            raise ValueError(
+                f"target_units {target_units!r} is fractional — live "
+                "OANDA units are integral; scale the kernel's sizing "
+                "before routing live (integral-units contract)"
+            )
         current = self.broker.open_positions().get(self.instrument, 0.0)
-        delta = float(target_units) - current
-        if abs(delta) < 1.0:  # sub-unit residual: OANDA units are integral
+        delta = rounded_target - current
+        if abs(delta) < 0.5:
             return None
-        if target_units == 0:
+        if rounded_target == 0:
             return self.broker.close_position(self.instrument)
+        explicit_decision = decision_id is not None
+        if decision_id is None:
+            self._decision_seq += 1
+            decision_id = f"{self._session_tag}-{self._decision_seq}"
+        client_id = f"{self.client_id_prefix}-{self.instrument}-{decision_id}"
+        if explicit_decision:
+            prior = self.broker.order_by_client_id(client_id)
+            # a CANCELLED prior (FOK orders cancel routinely on missed
+            # liquidity) never traded and releases its client id on
+            # OANDA's side, so the decision is retried; any other state
+            # (pending / triggered / filled) means the decision reached
+            # the book — return it instead of double-submitting
+            if prior is not None and prior.get("state") != "CANCELLED":
+                return {"already_submitted": prior}
         return self.broker.market_order(
             self.instrument, delta,
             stop_loss=stop_loss, take_profit=take_profit,
             price_precision=self.price_precision,
+            client_id=client_id,
         )

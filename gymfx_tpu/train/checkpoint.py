@@ -251,11 +251,13 @@ def load_train_state(directory: str, trainer: Any, state_cls: Any):
                 directory, template=template_nt._asdict()
             )
             return state_cls(**restored), None, step
-        except Exception:
-            # the stored tree may predate newly-added EnvState fields
-            # (e.g. pending_forced, r4): raw-restore and rebuild with
-            # the documented backfills; a genuine mismatch still fails
-            # loudly inside _rebuild_like
+        except (ValueError, KeyError, TypeError):
+            # structure mismatch only: the stored tree may predate
+            # newly-added EnvState fields (e.g. pending_forced, r4) —
+            # raw-restore and rebuild with the documented backfills; a
+            # genuine mismatch still fails loudly inside _rebuild_like.
+            # I/O or orbax sharding errors propagate untouched so they
+            # don't surface as confusing rebuild errors.
             raw, step = load_checkpoint(directory, template=None)
             return _rebuild_like(template_nt, raw), None, step
     # params-only checkpoint (round-2 format / PBT best member)

@@ -171,12 +171,37 @@ class Optimizer:
         }
 
 
+def atr_period_bounds(config: Dict[str, Any]) -> Tuple[int, int]:
+    """The sweepable ``atr_period`` range: a user ``optimize_params``
+    override wins; otherwise the builtin strategy schema's 7..30
+    (reference strategy_plugins/direct_atr_sltp.py:346)."""
+    override = next(
+        ((l, h) for n, l, h in hparam_schema(config) if n == "atr_period"),
+        None,
+    )
+    if override is None:
+        from gymfx_tpu.plugins.builtin.strategies import (
+            hparam_schema as _builtin_schema,
+        )
+
+        override = next(
+            (l, h) for n, l, h, _t in _builtin_schema() if n == "atr_period"
+        )
+    lo, hi = int(override[0]), int(override[1])
+    if lo < 1 or hi < lo:
+        raise ValueError(
+            f"atr_period bounds [{lo}, {hi}] must be positive ints with "
+            "low <= high (ring-buffer length)"
+        )
+    return lo, hi
+
+
 def atr_period_grid(config: Dict[str, Any]) -> List[int]:
     """The outer-sweep grid for ``atr_period``.  Explicit
-    ``optimize_atr_periods`` wins; otherwise the ATR strategy gets a
-    default grid spanning the reference schema's 7..30 int range
-    (strategy_plugins/direct_atr_sltp.py:346) UNLESS the user pinned
-    ``atr_period`` in the config; non-ATR strategies never sweep."""
+    ``optimize_atr_periods`` wins (validated against the schema bounds);
+    otherwise the ATR strategy gets a default 4-point grid spanning
+    :func:`atr_period_bounds` UNLESS the user pinned ``atr_period`` in
+    the config; non-ATR strategies never sweep."""
     raw = config.get("optimize_atr_periods")
     if isinstance(raw, str):  # CLI unknown-arg path delivers a JSON string
         import json
@@ -191,12 +216,28 @@ def atr_period_grid(config: Dict[str, Any]) -> List[int]:
     if isinstance(raw, (int, float)):  # scalar: a one-point grid
         raw = [raw]
     if raw:
-        return sorted({int(p) for p in raw})
+        lo, hi = atr_period_bounds(config)
+        grid = sorted({int(p) for p in raw})
+        bad = [p for p in grid if not lo <= p <= hi]
+        if bad:
+            raise ValueError(
+                f"optimize_atr_periods entries {bad} outside the strategy "
+                f"schema's [{lo}, {hi}] range (plugins/builtin/"
+                "strategies.py:hparam_schema, or the optimize_params "
+                "override) — the summary reports grid points as schema "
+                "low/high, so out-of-range periods would misdescribe the "
+                "search space"
+            )
+        return grid
     if (
         str(config.get("strategy_plugin", "")) == "direct_atr_sltp"
         and config.get("atr_period") is None
     ):
-        return [7, 14, 21, 30]
+        lo, hi = atr_period_bounds(config)
+        if (lo, hi) == (7, 30):
+            return [7, 14, 21, 30]  # the documented reference-range grid
+        span = hi - lo
+        return sorted({lo + span * i // 3 for i in range(4)})
     return []
 
 
@@ -215,10 +256,21 @@ def optimize_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         if period is not None:
             cfg["atr_period"] = int(period)
         env = Environment(cfg)
+        # atr_period is swept OUTSIDE the GA (static ring-buffer shape);
+        # an optimize_params override listing it feeds atr_period_grid's
+        # bounds, never the inner continuous schema
+        inner_schema = [s for s in hparam_schema(cfg) if s[0] != "atr_period"]
+        population = int(cfg.get("optimize_population", 32))
+        generations = int(cfg.get("optimize_generations", 8))
+        if not inner_schema:
+            # nothing continuous to tune: every candidate is identical,
+            # so one minimal evaluation per grid point scores the period
+            # without burning population x generations of rollouts
+            population, generations = 2, 1
         optimizer = Optimizer(
             env,
-            hparam_schema(cfg),
-            population=int(cfg.get("optimize_population", 32)),
+            inner_schema,
+            population=population,
             risk_lambda=float(
                 cfg.get("risk_lambda", cfg.get("risk_penalty_lambda", 1.0))
             ),
@@ -226,7 +278,7 @@ def optimize_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
             episode_steps=cfg.get("steps"),
         )
         return optimizer.run(
-            generations=int(cfg.get("optimize_generations", 8)),
+            generations=generations,
             seed=int(cfg.get("seed", 0) or 0),
         )
 
@@ -241,6 +293,16 @@ def optimize_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         return result
 
     grid = atr_period_grid(config)
+    if not grid and any(n == "atr_period" for n, _, _ in hparam_schema(config)):
+        # atr_period never reaches the inner GA (static shape), so an
+        # optimize_params declaring it with nothing sweeping it would
+        # silently optimize nothing — fail the way the old inner-schema
+        # rejection did
+        raise ValueError(
+            "optimize_params declares atr_period but nothing sweeps it: "
+            "unpin atr_period from the config or pass "
+            "optimize_atr_periods (non-ATR strategies cannot sweep it)"
+        )
     if not grid:
         return label(run_at(None))
 

@@ -81,7 +81,7 @@ def test_market_order_payload_with_brackets():
     assert order["units"] == "-2500"          # signed integral units
     assert order["stopLossOnFill"]["price"] == "1.23457"  # 5-digit precision
     assert order["takeProfitOnFill"]["price"] == "1.10000"
-    with pytest.raises(ValueError, match="nonzero"):
+    with pytest.raises(ValueError, match="round to zero"):
         b.market_order("EUR_USD", 0)
 
 
@@ -130,6 +130,111 @@ def test_router_noop_at_target():
     assert router.submit_target(1000) is None
     # only the position poll hit the wire
     assert [c["method"] for c in t.calls] == ["GET"]
+
+
+def test_units_round_not_truncate_and_zero_rounds_refused():
+    b, t = _broker()
+    b.market_order("EUR_USD", 1499.7)
+    assert t.calls[-1]["body"]["order"]["units"] == "1500"  # round, not trunc
+    b.market_order("EUR_USD", -1499.7)
+    assert t.calls[-1]["body"]["order"]["units"] == "-1500"
+    with pytest.raises(ValueError, match="round to zero"):
+        b.market_order("EUR_USD", 0.4)
+
+
+def test_client_id_attached_and_deterministic_per_decision():
+    """Retry safety (ADVICE r4): every routed order carries a
+    deterministic clientExtensions id, so a blind resubmit of the same
+    decision is a duplicate-id API error, not a second fill."""
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    router = TargetOrderRouter(b, "EUR_USD")
+    router.submit_target(1000, decision_id="bar-42")
+    first = t.calls[-1]["body"]["order"]["clientExtensions"]["id"]
+    assert first == "gymfx-EUR_USD-bar-42"
+    # the retry of the SAME decision reuses the id verbatim
+    router.submit_target(1000, decision_id="bar-42")
+    assert t.calls[-1]["body"]["order"]["clientExtensions"]["id"] == first
+    # without an explicit decision_id the router sequences its own ids
+    router.submit_target(2000)
+    auto1 = t.calls[-1]["body"]["order"]["clientExtensions"]["id"]
+    router.submit_target(3000)
+    auto2 = t.calls[-1]["body"]["order"]["clientExtensions"]["id"]
+    assert auto1 != auto2 and auto1.startswith("gymfx-EUR_USD-")
+
+
+def test_retry_after_visible_fill_reconciles_to_noop():
+    """If the first submit WAS accepted and the fill is visible, the
+    retry re-reads positions and recomputes a zero delta — no order."""
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {
+        "positions": [{"instrument": "EUR_USD",
+                       "long": {"units": "1000"}, "short": {"units": "0"}}]
+    })
+    router = TargetOrderRouter(b, "EUR_USD")
+    assert router.submit_target(1000, decision_id="bar-7") is None
+    assert [c["method"] for c in t.calls] == ["GET"]
+
+
+def test_retry_of_filled_decision_returns_original_order_not_a_second_fill():
+    """OANDA only enforces client-id uniqueness among PENDING orders, so
+    a filled FOK market order would not collide — the router therefore
+    looks the id up (any state) before submitting an explicit decision."""
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    t.route("GET", "/orders/@gymfx-EUR_USD-bar-42", 200,
+            {"order": {"id": "77", "state": "FILLED"}})
+    router = TargetOrderRouter(b, "EUR_USD")
+    res = router.submit_target(1000, decision_id="bar-42")
+    assert res == {"already_submitted": {"id": "77", "state": "FILLED"}}
+    assert all(c["method"] == "GET" for c in t.calls)  # never POSTed
+
+
+def test_cancelled_prior_order_is_retried_not_swallowed():
+    """A FOK market order that OANDA CANCELLED (missed liquidity) never
+    traded — the retry must resubmit, not short-circuit."""
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    t.route("GET", "/orders/@gymfx-EUR_USD-bar-42", 200,
+            {"order": {"id": "77", "state": "CANCELLED"}})
+    router = TargetOrderRouter(b, "EUR_USD")
+    router.submit_target(1000, decision_id="bar-42")
+    assert t.calls[-1]["method"] == "POST"
+    assert t.calls[-1]["body"]["order"]["units"] == "1000"
+
+
+def test_client_id_with_path_unsafe_chars_is_percent_encoded():
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    router = TargetOrderRouter(b, "EUR_USD")
+    router.submit_target(1000, decision_id="2026-07-30 12:00")
+    lookup = next(c for c in t.calls if "/orders/@" in c["url"])
+    assert " " not in lookup["url"] and "%20" in lookup["url"]
+    assert t.calls[-1]["method"] == "POST"  # 200-{} lookup -> proceeds
+
+
+def test_unknown_client_id_404_lets_the_submit_proceed():
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    t.route("GET", "/orders/@gymfx-EUR_USD-bar-9", 404,
+            {"errorMessage": "no such order"})
+    router = TargetOrderRouter(b, "EUR_USD")
+    router.submit_target(1000, decision_id="bar-9")
+    assert t.calls[-1]["method"] == "POST"
+    assert t.calls[-1]["body"]["order"]["clientExtensions"]["id"] == (
+        "gymfx-EUR_USD-bar-9"
+    )
+
+
+def test_fractional_target_refused_loudly():
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    router = TargetOrderRouter(b, "EUR_USD")
+    with pytest.raises(ValueError, match="integral"):
+        router.submit_target(0.5)
+    with pytest.raises(ValueError, match="integral"):
+        router.submit_target(1000.25)
+    assert t.calls == []  # refused before touching the wire
 
 
 def test_plugin_gate_and_wiring(monkeypatch):

@@ -74,13 +74,13 @@ def test_cli_optimization_mode(tmp_path):
         "--strategy_plugin", "direct_atr_sltp",
         "--steps", "80", "--quiet_mode",
         "--optimize_population", "6", "--optimize_generations", "2",
-        "--optimize_atr_periods", "[5, 10]",
+        "--optimize_atr_periods", "[7, 10]",
         "--results_file", str(tmp_path / "opt.json"),
     ])
     assert s["mode"] == "optimization"
     # the full reference schema (k_sl, k_tp, atr_period) is covered
     assert "best_params" in s and "k_sl" in s["best_params"]
-    assert s["best_params"]["atr_period"] in (5, 10)
+    assert s["best_params"]["atr_period"] in (7, 10)
     assert len(s["atr_period_sweep"]) == 2
 
 
@@ -97,6 +97,69 @@ def test_atr_period_grid_rules():
     ) == []
     # non-ATR strategies never sweep
     assert atr_period_grid({"strategy_plugin": "default_strategy"}) == []
+    # grid entries outside the strategy schema's 7..30 are rejected
+    # loudly (ADVICE r4): the summary would misreport them as low/high
+    for bad in ([3], [40], [0], [-7], [7, 99]):
+        with pytest.raises(ValueError, match="schema"):
+            atr_period_grid({"optimize_atr_periods": bad})
+
+
+def test_optimize_params_override_drives_atr_bounds_and_grid():
+    from gymfx_tpu.train.optimize import atr_period_grid
+
+    cfg = {
+        "strategy_plugin": "direct_atr_sltp",
+        "optimize_params": {"atr_period": [10, 20], "k_sl": [1, 4]},
+    }
+    # the default grid spans the user's override, not the builtin 7..30
+    grid = atr_period_grid(cfg)
+    assert grid[0] == 10 and grid[-1] == 20
+    assert all(10 <= p <= 20 for p in grid)
+    # explicit entries validate against the override bounds too
+    assert atr_period_grid({**cfg, "optimize_atr_periods": [10, 20]}) == [10, 20]
+    with pytest.raises(ValueError, match="schema"):
+        atr_period_grid({**cfg, "optimize_atr_periods": [7]})
+
+
+def test_atr_only_optimize_params_short_circuits_the_inner_ga():
+    """optimize_params listing ONLY atr_period leaves nothing continuous
+    to tune: each grid point is scored with one minimal evaluation
+    instead of population x generations of identical rollouts."""
+    from gymfx_tpu.train.optimize import optimize_from_config
+
+    df = _noisy_df()
+    path = "/tmp/optimize_atr_only_data.csv"
+    df.reset_index().to_csv(path, index=False)
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        input_data_file=path, window_size=8, timeframe="M1",
+        strategy_plugin="direct_atr_sltp", position_size=2000.0,
+        optimize_params={"atr_period": [7, 12]},
+        optimize_atr_periods=[7, 12],
+        optimize_population=32, optimize_generations=8, steps=60,
+    )
+    config.pop("atr_period", None)
+    result = optimize_from_config(config)
+    assert result["best_params"] == {"atr_period": 7} or result[
+        "best_params"
+    ] == {"atr_period": 12}
+    # the short-circuit ran ONE generation of a 2-member population,
+    # not the configured 32 x 8
+    assert result["generations"] == 1
+    assert len(result["history"]) == 1
+    assert result["population"] == 2
+
+
+def test_atr_period_in_optimize_params_with_nothing_sweeping_it_is_loud():
+    from gymfx_tpu.train.optimize import optimize_from_config
+
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        strategy_plugin="direct_atr_sltp", atr_period=14,  # pinned
+        optimize_params={"atr_period": [10, 20]},
+    )
+    with pytest.raises(ValueError, match="nothing sweeps it"):
+        optimize_from_config(config)
 
 
 def test_atr_period_sweep_selects_best_by_fitness():
@@ -110,12 +173,12 @@ def test_atr_period_sweep_selects_best_by_fitness():
         input_data_file=path, window_size=8, timeframe="M1",
         strategy_plugin="direct_atr_sltp", position_size=2000.0,
         optimize_population=6, optimize_generations=2, steps=100,
-        optimize_atr_periods=[5, 12],
+        optimize_atr_periods=[7, 12],
     )
     config.pop("atr_period", None)
     result = optimize_from_config(config)
-    assert result["best_params"]["atr_period"] in (5, 12)
-    assert {s["atr_period"] for s in result["atr_period_sweep"]} == {5, 12}
+    assert result["best_params"]["atr_period"] in (7, 12)
+    assert {s["atr_period"] for s in result["atr_period_sweep"]} == {7, 12}
     # the winner is the sweep's max-fitness row
     winner = max(result["atr_period_sweep"], key=lambda s: s["best_rap"])
     assert result["best_params"]["atr_period"] == winner["atr_period"]

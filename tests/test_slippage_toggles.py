@@ -153,6 +153,64 @@ def test_slip_match_caps_sl_fill_into_the_bar_range():
     assert capped == pytest.approx(0.995, rel=1e-6)
 
 
+def test_slip_match_fill_stays_in_bar_under_venue_quantization():
+    """slip_match + venue quantization (ADVICE r4): the capped entry
+    price (high=1.0006) would re-quantize to 1.001 — half a tick
+    OUTSIDE the bar.  The engine snaps to the nearest in-bar tick
+    instead, so the fill lands on 1.000 and the in-range guarantee
+    survives quantization."""
+    opens = [1.0] * 8
+    highs = [1.0006] * 8
+    lows = [0.999] * 8
+    env = make_env(
+        make_df([1.0] * 8, opens=opens, highs=highs, lows=lows),
+        slippage_perc=SLIP,
+        position_size=1000.0,
+        slip_match=True,
+        venue_quantization=True,
+        price_precision=3,       # tick 0.001 > bar headroom above the open
+    )
+    state, obs = env.reset()
+    state, *_ = env.step(state, 1)
+    state, *_ = env.step(state, 0)
+    assert float(state.pos) > 0
+    entry = float(state.entry_price)
+    assert lows[0] <= entry <= highs[0]
+    assert entry == pytest.approx(1.000, abs=1e-9)
+
+
+def test_slip_match_bracket_exit_stays_in_bar_under_venue_quantization():
+    """The same in-bar guarantee on the SL exit path: the capped stop
+    fill (low=0.9994) would re-quantize to 0.999 — below the bar — so
+    the engine snaps up to 1.000, the nearest in-bar tick."""
+    opens = [1.01] * 3 + [1.005] * 5
+    highs = [1.01] * 3 + [1.005] * 5
+    lows = [1.01] * 3 + [0.9994] * 5
+    closes = [1.01] * 3 + [1.0] * 5
+    env = make_env(
+        make_df(closes, opens=opens, highs=highs, lows=lows),
+        slippage_perc=SLIP,
+        position_size=1000.0,
+        strategy_plugin="direct_fixed_sltp",
+        sl_pips=100.0,           # SL at 1.00, triggered intrabar
+        tp_pips=900.0,
+        pip_size=0.0001,
+        slip_match=True,
+        venue_quantization=True,
+        price_precision=3,       # tick 0.001
+    )
+    state, obs = env.reset()
+    state, *_ = env.step(state, 1)
+    last = None
+    for _ in range(5):
+        state, obs, r, done, info = env.step(state, 0)
+        last = state
+    assert float(last.pos) == 0.0    # stopped out
+    exit_price = 1.01 + float(last.trade_pnl_sum) / 1000.0
+    assert lows[-1] - 1e-6 <= exit_price <= highs[-1] + 1e-6
+    assert exit_price == pytest.approx(1.000, abs=1e-6)  # f32 episode math
+
+
 def test_crosscheck_refuses_non_default_switches():
     from gymfx_tpu.simulation.crosscheck import crosscheck_episode
 
