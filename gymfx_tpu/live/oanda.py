@@ -182,13 +182,22 @@ class OandaLiveBroker:
                 return None
             raise
 
-    def close_position(self, instrument: str) -> Dict[str, Any]:
+    def close_position(self, instrument: str, *,
+                       client_id: Optional[str] = None) -> Dict[str, Any]:
         """Flatten the instrument (both sides, like the scan engine's
-        force-flat)."""
+        force-flat).  ``client_id`` attaches to the venue-generated
+        market order(s) so a retried flatten decision is discoverable
+        via :meth:`order_by_client_id` (net positions only ever hold one
+        side, so the shared id cannot collide with itself)."""
+        payload: Dict[str, Any] = {"longUnits": "ALL", "shortUnits": "ALL"}
+        if client_id:
+            ext = {"id": str(client_id)}
+            payload["longClientExtensions"] = ext
+            payload["shortClientExtensions"] = ext
         return self._request(
             "PUT",
             f"/v3/accounts/{self.account_id}/positions/{instrument}/close",
-            {"longUnits": "ALL", "shortUnits": "ALL"},
+            payload,
         )
 
 
@@ -250,8 +259,6 @@ class TargetOrderRouter:
         delta = rounded_target - current
         if abs(delta) < 0.5:
             return None
-        if rounded_target == 0:
-            return self.broker.close_position(self.instrument)
         explicit_decision = decision_id is not None
         if decision_id is None:
             self._decision_seq += 1
@@ -263,9 +270,15 @@ class TargetOrderRouter:
             # liquidity) never traded and releases its client id on
             # OANDA's side, so the decision is retried; any other state
             # (pending / triggered / filled) means the decision reached
-            # the book — return it instead of double-submitting
+            # the book — return it instead of double-submitting.  The
+            # lookup runs for FLATTEN decisions too: close_position's
+            # venue-generated market orders carry the same id.
             if prior is not None and prior.get("state") != "CANCELLED":
                 return {"already_submitted": prior}
+        if rounded_target == 0:
+            return self.broker.close_position(
+                self.instrument, client_id=client_id
+            )
         return self.broker.market_order(
             self.instrument, delta,
             stop_loss=stop_loss, take_profit=take_profit,

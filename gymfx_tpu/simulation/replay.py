@@ -151,6 +151,24 @@ def make_qty(spec: InstrumentSpec, value: float) -> float:
     return round(float(value), spec.size_precision)
 
 
+def snap_price_in_bar(
+    spec: InstrumentSpec, price: float, low: float, high: float
+) -> float:
+    """Clip ``price`` into the bar's [low, high], then snap to the
+    nearest IN-BAR book price — the float64 twin of the scan engine's
+    ``broker.snap_in_bar`` (slip_match's in-range guarantee under venue
+    quantization).  A bar narrower than one tick keeps the nearest
+    tick instead of oscillating."""
+    p = min(max(float(price), float(low)), float(high))
+    q = make_price(spec, p)
+    tick = 10.0 ** (-spec.price_precision)
+    if q > high and q - tick >= low:
+        q = make_price(spec, q - tick)
+    elif q < low and q + tick <= high:
+        q = make_price(spec, q + tick)
+    return q
+
+
 class _Position:
     __slots__ = ("units", "avg_price")
 
@@ -201,7 +219,29 @@ class ReplayAdapter:
         default_leverage: float = 20.0,
         financing_rate_data: Any = None,
         enforce_margin_closeout: Optional[bool] = None,
+        slip_open: bool = True,
+        slip_limit: bool = False,
+        slip_match: bool = False,
     ) -> Dict[str, Any]:
+        """``slip_open`` / ``slip_limit`` / ``slip_match`` mirror the
+        scan engine's per-fill-type slippage switches (the reference
+        broker's backtrader ``set_slippage_perc`` configuration,
+        reference broker_plugins/default_broker.py:52) as venue
+        behavior, so the crosscheck can bound non-default switch
+        semantics (VERDICT r4 item #7):
+
+          * ``slip_open`` off — market-order fills and GAP stop fills
+            (a frame opening through the stop) execute at the raw first
+            tick instead of the adverse-displaced book side; intrabar
+            stop fills always pay the book (the scan's ``sl_scale``).
+          * ``slip_limit`` on — take-profit limit exits pay the
+            adverse-displaced book, capped never-worse-than-the-limit.
+          * ``slip_match`` on — every fill price is clipped into the
+            frame's [low, high] and snapped to the nearest in-bar book
+            price (``snap_price_in_bar``).
+
+        Defaults preserve the historical venue behavior bit-for-bit
+        (committed determinism hashes depend on it)."""
         profile = self.profile
         if profile.financing_enabled and financing_rate_data is None:
             raise ValueError(
@@ -333,18 +373,30 @@ class ReplayAdapter:
             if pos.units == 0 or pos.units * units_before < 0:
                 brackets.pop(instrument_id, None)
 
-        def market_price(spec: InstrumentSpec, mid: float, side: str) -> float:
+        def market_price(
+            spec: InstrumentSpec, mid: float, side: str,
+            frame: Optional[MarketFrame] = None,
+        ) -> float:
             """Top-of-book fill price for a market order, with the fill
-            model's one-tick probabilistic slippage."""
-            price = make_price(
-                spec, mid * (1.0 + adverse) if side == "BUY" else mid * (1.0 - adverse)
-            )
+            model's one-tick probabilistic slippage.  ``slip_open`` off
+            fills at the raw tick; ``slip_match`` (with a frame) snaps
+            the price into the frame's range."""
+            if slip_open:
+                raw = mid * (1.0 + adverse) if side == "BUY" else mid * (1.0 - adverse)
+            else:
+                raw = mid
+            price = make_price(spec, raw)
             if fill_model.slips():
                 tick = 10.0 ** (-spec.price_precision)
                 price = price + tick if side == "BUY" else price - tick
+            if slip_match and frame is not None:
+                price = snap_price_in_bar(spec, price, frame.low, frame.high)
             return price
 
-        def check_brackets(instrument_id: str, bid: float, ask: float, mid: float, ts: int) -> None:
+        def check_brackets(
+            instrument_id: str, bid: float, ask: float, mid: float, ts: int,
+            frame: Optional[MarketFrame] = None, first_tick: bool = False,
+        ) -> None:
             nonlocal order_seq, order_count
             br = brackets.get(instrument_id)
             pos = positions[instrument_id]
@@ -377,12 +429,43 @@ class ReplayAdapter:
                 # bar opening beyond it), the fill is the gapped book
                 # price, not the stop price — Nautilus stop->market
                 # semantics and the scan engine's gap-fill-at-open
-                # (core/broker.py check_brackets)
-                exit_price = min(sl, bid) if long else max(sl, ask)
+                # (core/broker.py check_brackets).  slip_open off: the
+                # GAP fill pays the raw open instead of the book (the
+                # scan's sl_scale gating); intrabar stops always pay
+                # the book.
+                gap = first_tick and (mid <= sl if long else mid >= sl)
+                if gap and not slip_open:
+                    book = make_price(specs[instrument_id], mid)
+                else:
+                    book = bid if long else ask
+                exit_price = min(sl, book) if long else max(sl, book)
+                if slip_match and frame is not None:
+                    exit_price = snap_price_in_bar(
+                        specs[instrument_id], exit_price, frame.low, frame.high
+                    )
             else:
                 if not fill_model.limit_fills():
                     return
-                if limit_policy == "cross":
+                if slip_limit:
+                    # the limit exit pays the adverse-displaced book —
+                    # under cross that is the trigger tick's book side;
+                    # other policies slip the limit price itself — then
+                    # slip_match clips into the bar, and the cap applies
+                    # LAST: a limit never fills worse than its price
+                    # (the scan's check_brackets order of operations)
+                    if limit_policy == "cross":
+                        slipped = bid if long else ask
+                    else:
+                        slipped = make_price(
+                            specs[instrument_id],
+                            tp * (1.0 - adverse) if long else tp * (1.0 + adverse),
+                        )
+                    if slip_match and frame is not None:
+                        slipped = snap_price_in_bar(
+                            specs[instrument_id], slipped, frame.low, frame.high
+                        )
+                    exit_price = max(slipped, tp) if long else min(slipped, tp)
+                elif limit_policy == "cross":
                     # price improvement: fill at the touching tick's book
                     exit_price = bid if long else ask
                 else:
@@ -416,7 +499,7 @@ class ReplayAdapter:
                 signed = po["qty"] if po["side"] == "BUY" else -po["qty"]
                 inflight_units[frame.instrument_id] -= signed
                 spec = specs[frame.instrument_id]
-                price = market_price(spec, first_mid, po["side"])
+                price = market_price(spec, first_mid, po["side"], frame)
                 fill(
                     frame.instrument_id,
                     po["side"],
@@ -687,7 +770,7 @@ class ReplayAdapter:
                 frame.instrument_id,
                 side,
                 qty,
-                market_price(spec, mid, side),
+                market_price(spec, mid, side, frame),
                 mid,
                 frame.ts_event_ns,
                 order_id,
@@ -707,11 +790,12 @@ class ReplayAdapter:
             flush_pending(frame, path[0])
             # walk intrabar ticks: brackets can exit mid-path (book
             # prices live at the instrument's price precision)
-            for mid in path:
+            for tick_i, mid in enumerate(path):
                 bid = make_price(spec, mid * (1.0 - adverse))
                 ask = make_price(spec, mid * (1.0 + adverse))
                 last_mid[frame.instrument_id] = mid
-                check_brackets(frame.instrument_id, bid, ask, mid, frame.ts_event_ns)
+                check_brackets(frame.instrument_id, bid, ask, mid,
+                               frame.ts_event_ns, frame, tick_i == 0)
             apply_rollover(frame.ts_event_ns)
             process_action(frame, spec)
             # account maintenance check at the frame end (its last path

@@ -37,3 +37,56 @@ def make_env(df, **overrides):
 def uptrend_df(n=40, start_price=1.1, rate=2e-4):
     closes = start_price * (1.0 + rate) ** np.arange(n)
     return make_df(closes, highs=closes + 1e-5, lows=closes - 1e-5)
+
+
+def build_smoke_trainer(family, csv_path, csv2_path=None):
+    """Tiny trainer fixture shared by the 2-process distributed smoke
+    workers (subprocess scripts) and their in-process single-process
+    references (tests/test_distributed_smoke.py, SURVEY §5.8).
+
+    Returns ``(trainer, state_cls, params_field)`` — ``params_field``
+    names the learner-parameter member used for fingerprinting."""
+    from gymfx_tpu.config import DEFAULT_VALUES
+
+    if family == "portfolio":
+        from gymfx_tpu.core.portfolio import PortfolioEnvironment
+        from gymfx_tpu.train.portfolio_ppo import (
+            PortfolioPPOConfig,
+            PortfolioPPOTrainer,
+            PortfolioTrainState,
+        )
+
+        env = PortfolioEnvironment({
+            "portfolio_files": {
+                "EUR_USD": str(csv_path), "GBP_USD": str(csv2_path)
+            },
+            "window_size": 8,
+            "initial_cash": 10000.0,
+        })
+        pcfg = PortfolioPPOConfig(n_envs=8, horizon=8, epochs=1, minibatches=2)
+        return PortfolioPPOTrainer(env, pcfg), PortfolioTrainState, "params"
+
+    from gymfx_tpu.core.runtime import Environment
+
+    config = dict(DEFAULT_VALUES)
+    config.update(input_data_file=str(csv_path), window_size=8,
+                  timeframe="M1", num_envs=8,
+                  policy_kwargs={"hidden": [16, 16]})
+    if family == "ppo":
+        from gymfx_tpu.train.ppo import PPOTrainer, TrainState, ppo_config_from
+
+        config.update(ppo_horizon=8, ppo_epochs=1, ppo_minibatches=2)
+        env = Environment(config)
+        return PPOTrainer(env, ppo_config_from(config)), TrainState, "params"
+    if family == "impala":
+        from gymfx_tpu.train.impala import (
+            ImpalaState,
+            ImpalaTrainer,
+            impala_config_from,
+        )
+
+        config.update(impala_unroll=8, policy="mlp")
+        env = Environment(config)
+        trainer = ImpalaTrainer(env, impala_config_from(config))
+        return trainer, ImpalaState, "learner_params"
+    raise ValueError(f"unknown smoke-trainer family {family!r}")

@@ -254,6 +254,36 @@ def test_atr_sltp_bracket_episode_reconciles():
     assert result["within_bound"], result
 
 
+@pytest.mark.parametrize("slip_open", [True, False])
+@pytest.mark.parametrize("slip_limit", [False, True])
+@pytest.mark.parametrize("slip_match", [False, True])
+def test_slippage_switch_combinations_reconcile(slip_open, slip_limit, slip_match):
+    """All 8 reference-broker slippage-switch combinations
+    (``set_slippage_perc(perc, slip_open, slip_limit, slip_match)``,
+    reference broker_plugins/default_broker.py:52) are independently
+    bounded (VERDICT r4 item #7): the replay venue mirrors the switches
+    as fill behavior (simulation/replay.py run) and a bracketed episode
+    with nonzero slippage reconciles within the stated quantization
+    bound.  The bound is meaningful: one unmirrored switch shifts fills
+    by slippage x price x units — several times the bound."""
+    result = crosscheck_episode(
+        _config(
+            driver_mode="random",
+            steps=300,
+            strategy_plugin="direct_fixed_sltp",
+            sl_pips=10.0,
+            tp_pips=20.0,
+            slippage_perc=2e-5,
+            slip_open=slip_open,
+            slip_limit=slip_limit,
+            slip_match=slip_match,
+        ),
+        seed=5,
+    )
+    assert result["replay_fills"] > 20
+    assert result["within_bound"], (slip_open, slip_limit, slip_match, result)
+
+
 def test_continuous_action_mode_reconciles():
     """Continuous mode works through the decision stream — the pending
     orders record the thresholded intents, not the raw floats."""

@@ -52,9 +52,10 @@ print("RESULT " + json.dumps(np.asarray(jax.device_get(w1)).tolist()),
 """
 
 
-_PPO_WORKER = r"""
+_TRAINER_WORKER = r"""
 import json, sys
 pid = int(sys.argv[1]); coord = sys.argv[2]; csv_path = sys.argv[3]
+family = sys.argv[4]; csv2_path = sys.argv[5]
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -67,16 +68,11 @@ from gymfx_tpu.parallel.mesh import initialize_distributed, make_mesh
 initialize_distributed(coord, 2, pid)
 assert jax.process_count() == 2 and len(jax.devices()) == 4
 
-from gymfx_tpu.config import DEFAULT_VALUES
-from gymfx_tpu.core.runtime import Environment
-from gymfx_tpu.train.ppo import PPOTrainer, TrainState, ppo_config_from
+from tests.helpers import build_smoke_trainer
 
-config = dict(DEFAULT_VALUES)
-config.update(input_data_file=csv_path, window_size=8, timeframe="M1",
-              num_envs=8, ppo_horizon=8, ppo_epochs=1, ppo_minibatches=2,
-              policy_kwargs={"hidden": [16, 16]})
-env = Environment(config)
-trainer = PPOTrainer(env, ppo_config_from(config))
+trainer, state_cls, params_field = build_smoke_trainer(
+    family, csv_path, csv2_path
+)
 
 mesh = make_mesh({"data": 4})
 rep = NamedSharding(mesh, P())
@@ -93,18 +89,15 @@ def to_global(tree, sh):
 
 
 # deterministic identical init on both processes, then globally placed:
-# params/opt/rng replicated, the ENV BATCH sharded over all 4 devices —
-# 2 per process, so the rollout and the gradient all-reduce both cross
-# the process boundary
+# params/opt/rng (and every other scalar carry) replicated, the ENV
+# BATCH sharded over all 4 devices — 2 per process, so the rollout and
+# the gradient all-reduce both cross the process boundary
+BATCHED = {"env_states", "obs_vec", "policy_carry"}
 s = trainer.init_state_from_key(jax.random.PRNGKey(0))
-state = TrainState(
-    params=to_global(s.params, rep),
-    opt_state=to_global(s.opt_state, rep),
-    env_states=to_global(s.env_states, batch),
-    obs_vec=to_global(s.obs_vec, batch),
-    policy_carry=to_global(s.policy_carry, batch),
-    rng=to_global(s.rng, rep),
-)
+state = state_cls(**{
+    f: to_global(getattr(s, f), batch if f in BATCHED else rep)
+    for f in s._fields
+})
 
 state, metrics = trainer.train_step(state)
 
@@ -117,7 +110,7 @@ def fingerprint(params):
 out = {
     "loss": float(jax.device_get(metrics["loss"])),
     "mean_reward": float(jax.device_get(metrics["mean_reward"])),
-    "fingerprint": float(jax.device_get(fingerprint(state.params))),
+    "fingerprint": float(jax.device_get(fingerprint(getattr(state, params_field)))),
 }
 print("RESULT " + json.dumps(out), flush=True)
 """
@@ -178,25 +171,32 @@ def test_two_process_distributed_sgd_step(tmp_path):
     np.testing.assert_allclose(results[0], -0.1 * grad, rtol=1e-5)
 
 
-def test_two_process_fused_ppo_train_step(tmp_path):
-    """VERDICT r4 item #4: one REAL fused PPOTrainer.train_step with the
-    env batch sharded across 2 processes (2 CPU devices each).  The
-    rollout scan, GAE and the gradient all-reduce all cross the process
-    boundary; both processes must agree with each other exactly and with
-    the single-process run up to reduction-order rounding."""
+@pytest.mark.parametrize("family", ["ppo", "impala", "portfolio"])
+def test_two_process_fused_train_step(family, tmp_path):
+    """VERDICT r4 item #4 (PPO) extended to every trainer family
+    (VERDICT r4 item #10): one REAL fused ``train_step`` with the env
+    batch sharded across 2 processes (2 CPU devices each).  The rollout
+    scan, advantage pass and the gradient all-reduce all cross the
+    process boundary; both processes must agree with each other exactly
+    and with the single-process run up to reduction-order rounding."""
     import pandas as pd
 
-    closes = 1.1 * (1.0 + 2e-4) ** np.arange(60)
-    df = pd.DataFrame({
-        "DATE_TIME": pd.date_range("2024-01-01", periods=60, freq="1min"),
-        "OPEN": closes, "HIGH": closes + 1e-5, "LOW": closes - 1e-5,
-        "CLOSE": closes, "VOLUME": np.zeros(60),
-    })
-    csv_path = tmp_path / "uptrend.csv"
-    df.to_csv(csv_path, index=False)
+    def write_csv(name, start):
+        closes = start * (1.0 + 2e-4) ** np.arange(60)
+        df = pd.DataFrame({
+            "DATE_TIME": pd.date_range("2024-01-01", periods=60, freq="1min"),
+            "OPEN": closes, "HIGH": closes + 1e-5, "LOW": closes - 1e-5,
+            "CLOSE": closes, "VOLUME": np.zeros(60),
+        })
+        path = tmp_path / name
+        df.to_csv(path, index=False)
+        return path
 
-    worker = tmp_path / "ppo_worker.py"
-    worker.write_text(_PPO_WORKER)
+    csv_path = write_csv("uptrend.csv", 1.1)
+    csv2_path = write_csv("uptrend2.csv", 1.3)
+
+    worker = tmp_path / "trainer_worker.py"
+    worker.write_text(_TRAINER_WORKER)
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
@@ -205,7 +205,8 @@ def test_two_process_fused_ppo_train_step(tmp_path):
     env["JAX_ENABLE_COMPILATION_CACHE"] = "false"
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(pid), coord, str(csv_path)],
+            [sys.executable, str(worker), str(pid), coord, str(csv_path),
+             family, str(csv2_path)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
             cwd=os.getcwd(), text=True,
         )
@@ -241,16 +242,11 @@ def test_two_process_fused_ppo_train_step(tmp_path):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    from gymfx_tpu.config import DEFAULT_VALUES
-    from gymfx_tpu.core.runtime import Environment
-    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+    from tests.helpers import build_smoke_trainer
 
-    config = dict(DEFAULT_VALUES)
-    config.update(input_data_file=str(csv_path), window_size=8,
-                  timeframe="M1", num_envs=8, ppo_horizon=8, ppo_epochs=1,
-                  ppo_minibatches=2, policy_kwargs={"hidden": [16, 16]})
-    ref_env = Environment(config)
-    tr = PPOTrainer(ref_env, ppo_config_from(config))
+    tr, _state_cls, params_field = build_smoke_trainer(
+        family, csv_path, csv2_path
+    )
     s = tr.init_state_from_key(jax.random.PRNGKey(0))
     s, metrics = tr.train_step(s)
     ref_loss = float(metrics["loss"])
@@ -264,7 +260,7 @@ def test_two_process_fused_ppo_train_step(tmp_path):
             for x in jax.tree.leaves(params)
         )
 
-    ref_fp = float(fingerprint(s.params))
+    ref_fp = float(fingerprint(getattr(s, params_field)))
     # parity up to f32 reduction-order rounding across device layouts
     assert results[0]["loss"] == pytest.approx(ref_loss, rel=1e-3)
     assert results[0]["fingerprint"] == pytest.approx(ref_fp, rel=1e-4)

@@ -112,12 +112,15 @@ def test_router_maps_decision_stream_to_orders():
     order = t.calls[-1]["body"]["order"]
     assert order["units"] == "-3000"
     assert order["stopLossOnFill"]["price"] == "1.25000"
-    # target flat -> position close endpoint, both sides
+    # target flat -> position close endpoint, both sides, with the
+    # decision's client id on the venue-generated market orders
     router.submit_target(0)
     close = t.calls[-1]
     assert close["method"] == "PUT"
     assert "/positions/EUR_USD/close" in close["url"]
-    assert close["body"] == {"longUnits": "ALL", "shortUnits": "ALL"}
+    assert close["body"]["longUnits"] == "ALL"
+    assert close["body"]["shortUnits"] == "ALL"
+    assert close["body"]["longClientExtensions"]["id"].startswith("gymfx-EUR_USD-")
 
 
 def test_router_noop_at_target():
@@ -188,6 +191,23 @@ def test_retry_of_filled_decision_returns_original_order_not_a_second_fill():
     res = router.submit_target(1000, decision_id="bar-42")
     assert res == {"already_submitted": {"id": "77", "state": "FILLED"}}
     assert all(c["method"] == "GET" for c in t.calls)  # never POSTed
+
+
+def test_retried_flatten_decision_short_circuits_like_orders_do():
+    """The flatten path gets the same duplicate-submit protection: a
+    retried close whose venue market order is visible by client id
+    returns already_submitted instead of double-closing."""
+    b, t = _broker()
+    t.route("GET", "/openPositions", 200, {
+        "positions": [{"instrument": "EUR_USD",
+                       "long": {"units": "1000"}, "short": {"units": "0"}}]
+    })
+    t.route("GET", "/orders/@gymfx-EUR_USD-flat-3", 200,
+            {"order": {"id": "91", "state": "FILLED"}})
+    router = TargetOrderRouter(b, "EUR_USD")
+    res = router.submit_target(0, decision_id="flat-3")
+    assert res == {"already_submitted": {"id": "91", "state": "FILLED"}}
+    assert all(c["method"] == "GET" for c in t.calls)  # no PUT
 
 
 def test_cancelled_prior_order_is_retried_not_swallowed():

@@ -211,11 +211,14 @@ def test_slip_match_bracket_exit_stays_in_bar_under_venue_quantization():
     assert exit_price == pytest.approx(1.000, abs=1e-6)  # f32 episode math
 
 
-def test_crosscheck_refuses_non_default_switches():
+def test_crosscheck_accepts_non_default_switches():
+    """Round 5 (VERDICT r4 #7): the crosscheck no longer refuses the
+    switches — the replay venue mirrors them (all 8 combinations are
+    exercised by tests/test_crosscheck.py)."""
     from gymfx_tpu.simulation.crosscheck import crosscheck_episode
 
     env = make_env(
         make_df([1.0] * 12), slippage_perc=SLIP, slip_limit=True
     )
-    with pytest.raises(ValueError, match="slip_open/slip_limit/slip_match"):
-        crosscheck_episode(dict(env.config), actions=[1, 0, 0], env=env)
+    result = crosscheck_episode(dict(env.config), actions=[1, 0, 0], env=env)
+    assert result["within_bound"], result
