@@ -161,19 +161,6 @@ def eval_checkpointed_policy(
     return summary
 
 
-def reject_eval_keys(config: Dict[str, Any], trainer_name: str) -> None:
-    """Honor-or-reject: trainers without held-out evaluation machinery
-    must refuse the out-of-sample keys rather than silently reporting
-    in-sample numbers."""
-    for key in ("eval_split", "eval_data_file"):
-        if config.get(key):
-            raise ValueError(
-                f"{key} is not supported by the {trainer_name} trainer "
-                "(no held-out evaluation machinery yet); remove the key "
-                "or use the single-pair trainers"
-            )
-
-
 def masked_reset(done, fresh_tree, cur_tree):
     """Where ``done`` (batch bool), replace each leaf of ``cur_tree``
     with the (broadcast) corresponding leaf of ``fresh_tree``.  Used for

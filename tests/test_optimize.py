@@ -49,7 +49,7 @@ def test_candidates_actually_change_outcomes():
         [[1.0, 1.5], [4.0, 6.0], [2.0, 3.0], [1.2, 5.5], [3.7, 2.0], [2.5, 2.5]],
         jnp.float32,
     )
-    rap, tr, dd = opt._fitness(pop, jax.random.PRNGKey(0))
+    rap, tr, dd, trades = opt._fitness(pop, jax.random.PRNGKey(0))
     assert len({round(float(x), 9) for x in rap}) > 1  # not all identical
 
 
@@ -148,6 +148,37 @@ def test_atr_only_optimize_params_short_circuits_the_inner_ga():
     assert result["generations"] == 1
     assert len(result["history"]) == 1
     assert result["population"] == 2
+
+
+def test_eval_split_auto_evaluates_the_winner_held_out():
+    """VERDICT r4 item #3: one optimization invocation with eval_split
+    returns in-sample fitness AND an automatic held-out evaluation of
+    the winner (the same episode definition, on bars the search never
+    saw)."""
+    from gymfx_tpu.train.optimize import optimize_from_config
+
+    df = _noisy_df(n=220)
+    path = "/tmp/optimize_holdout_data.csv"
+    df.reset_index().to_csv(path, index=False)
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        input_data_file=path, window_size=8, timeframe="M1",
+        strategy_plugin="direct_atr_sltp", position_size=2000.0,
+        optimize_population=6, optimize_generations=2, steps=100,
+        optimize_atr_periods=[7], eval_split=0.3,
+    )
+    config.pop("atr_period", None)
+    result = optimize_from_config(config)
+    assert result["eval_scope"] == "fitness_in_sample_winner_held_out"
+    ho = result["held_out"]
+    assert set(ho) >= {"rap", "total_return", "drawdown_fraction",
+                       "trades", "eval_bars", "train_bars"}
+    # the holdout really was held out of the fitness episodes
+    assert ho["train_bars"] + ho["eval_bars"] == 220
+    assert ho["eval_bars"] == 66
+    # and the selection-signal diagnostics ride along (VERDICT r4 #2)
+    assert all("rap_std" in h for h in result["history"])
+    assert isinstance(result["selection_signal"], bool)
 
 
 def test_atr_period_in_optimize_params_with_nothing_sweeping_it_is_loud():

@@ -251,11 +251,16 @@ def test_eval_split_holds_out_tail_chronologically(tmp_path):
 
     pe = eval_policy_from_config(dict(config))
     assert pe["eval_scope"] == "held_out"
-    # optimization mode must reject the keys it cannot honor
+    # optimization mode honors the keys (round 5): fitness stays
+    # in-sample, the WINNER is auto-evaluated on the held-out tail
+    # (full coverage: tests/test_optimize.py)
     from gymfx_tpu.train.optimize import optimize_from_config
 
-    with pytest.raises(ValueError, match="optimization"):
-        optimize_from_config(dict(config))
+    opt = optimize_from_config(
+        dict(config, optimize_population=4, optimize_generations=1, steps=40)
+    )
+    assert opt["eval_scope"] == "fitness_in_sample_winner_held_out"
+    assert opt["held_out"]["eval_bars"] == 30
 
     # both keys together is ambiguous -> loud error
     config["eval_data_file"] = str(csv)
