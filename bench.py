@@ -87,6 +87,12 @@ def main() -> None:
         # policy compute in bfloat16 (MXU-native; params/updates stay
         # f32) — measured ~10% faster than f32 at identical loss curves
         policy_dtype="bfloat16",
+        # trajectory (env-permuted) minibatches: contiguous update-phase
+        # DMA instead of the T*N random sample gather — measured 12.4M
+        # vs 8.3M steps/s at 8192 envs with identical held-out learning
+        # (train/ppo.py minibatch_scheme; r5 closes the wide-batch
+        # rollover this way: 32k envs sustain 12.5M)
+        ppo_minibatch_scheme="env_permute",
         window_size=32,
     )
     env = Environment(config)
@@ -106,7 +112,8 @@ def main() -> None:
             {
                 "metric": "ppo_env_steps_per_sec_per_chip",
                 "value": round(steps_per_sec, 1),
-                "unit": "env steps/sec/chip (PPO MLP bf16 policy, fused rollout+update)",
+                "unit": "env steps/sec/chip (PPO MLP bf16 policy, fused "
+                        "rollout+update, env-permuted minibatches)",
                 "vs_baseline": round(steps_per_sec / baseline_per_chip, 3),
                 # XLA cost-model FLOPs / public peak bf16 chip FLOPs
                 # (gymfx_tpu/bench_util.py); null off-TPU

@@ -123,6 +123,12 @@ def run_mode(config: Dict[str, Any]) -> Dict[str, Any]:
 
         return optimize_from_config(config)
     if config.get("driver_mode") == "policy":
+        if config.get("export_scaled_features"):
+            raise ValueError(
+                "export_scaled_features is supported on the scanned "
+                "diagnostic episode path only; run the export as a "
+                "separate inference invocation"
+            )
         if config.get("portfolio_files"):
             from gymfx_tpu.train.portfolio_ppo import (
                 eval_portfolio_policy_from_config,
@@ -148,6 +154,16 @@ def _run_env(config: Dict[str, Any]) -> Dict[str, Any]:
     if mode in ("buy_hold", "flat", "random", "replay") and not config.get("gym_loop"):
         return _run_env_scan(config)
 
+    if config.get("export_scaled_features"):
+        # honor-or-reject: the export is a scan-path feature (it reads
+        # the Environment's precomputed feature tensors) — silently
+        # producing no file would strand a downstream pipeline
+        raise ValueError(
+            "export_scaled_features is supported on the scanned episode "
+            "path only (builtin driver_mode without gym_loop); run the "
+            "export as a separate inference invocation"
+        )
+
     env = build_environment(config=config)
     decide = make_cli_driver(config)
     try:
@@ -163,6 +179,58 @@ def _run_env(config: Dict[str, Any]) -> Dict[str, Any]:
         return env.summary()
     finally:
         env.close()
+
+
+def _export_scaled_features(env, config, n_steps: int, path: str):
+    """Materialize the episode's scaled feature windows
+    ``(n_steps, window, F)`` and save them (.npz) for external ML
+    pipelines — the reference preprocessor family's raison d'etre
+    (reference preprocessor_plugins/feature_window_preprocessor.py
+    produces exactly these windows for a consumer model).
+
+    This is the product caller of the fused pallas kernel
+    (ops/window_zscore.py batched_scaled_windows): the IN-SCAN path
+    keeps the O(1)-per-step streaming carry (cheaper than any batched
+    materialization inside the episode), while this BATCHED
+    materialization — many steps at once — is the kernel's shape, 1.7x
+    the jitted-XLA twin on chip (examples/results/
+    pallas_kernel_bench.json)."""
+    import jax
+
+    from gymfx_tpu.ops.window_zscore import batched_scaled_windows
+
+    cfg, data = env.cfg, env.data
+    if cfg.n_features == 0:
+        raise ValueError(
+            "export_scaled_features requires feature_columns in the config "
+            "(the scaled windows ARE the feature-window preprocessor's "
+            "output)"
+        )
+    import jax.numpy as jnp
+
+    w = cfg.window_size
+    steps = jnp.arange(1, n_steps + 1, dtype=jnp.int32)
+    windows = batched_scaled_windows(
+        data.padded_features, data.feat_mean, data.feat_std,
+        data.feat_neutral, steps,
+        window=w, clip=float(cfg.feature_clip or 0.0),
+    )
+    arr = np.array(jax.device_get(windows), np.float32)
+    if any(cfg.binary_mask):
+        # binary passthrough columns carry raw values, exactly like the
+        # obs path (core/obs.py build_obs)
+        from numpy.lib.stride_tricks import sliding_window_view
+
+        raw = np.asarray(jax.device_get(data.padded_features), np.float32)
+        steps_np = np.arange(1, n_steps + 1)
+        for j, is_bin in enumerate(cfg.binary_mask):
+            if is_bin:
+                arr[:, :, j] = sliding_window_view(raw[:, j], w)[steps_np]
+    columns = [str(c) for c in (env.config.get("feature_columns") or [])]
+    np.savez_compressed(
+        path, scaled_windows=arr, feature_columns=np.asarray(columns)
+    )
+    return {"path": path, "shape": list(arr.shape), "columns": columns}
 
 
 def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
@@ -287,6 +355,12 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
             for a in np.asarray(out["action"])[:n_steps]:
                 writer.writerow([int(a)])
         summary["record_actions_file"] = str(record_path)
+
+    export_path = config.get("export_scaled_features")
+    if export_path:
+        summary["export_scaled_features"] = _export_scaled_features(
+            env, config, n_steps, str(export_path)
+        )
 
     if "event_context" in out:
         # event fields of the last executed (pre-termination) step,

@@ -63,6 +63,27 @@ def load_financing_rates(config: Dict[str, Any], financing_enabled: bool):
     return pd.read_csv(rate_path)
 
 
+def _parse_column_list(value: Any, key: str) -> list:
+    """Column-name lists arrive as real lists from file/library configs
+    and as JSON strings from the CLI unknown-arg passthrough (the same
+    convention as optimize_atr_periods, train/optimize.py)."""
+    if isinstance(value, str):
+        import json
+
+        try:
+            value = json.loads(value)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"{key} must be a JSON list of column names (e.g. "
+                f"'[\"CLOSE\", \"RET1\"]'), got {value!r}"
+            ) from e
+    if value is None:
+        return []
+    if not isinstance(value, (list, tuple)):
+        raise ValueError(f"{key} must be a list of column names, got {value!r}")
+    return [str(c) for c in value]
+
+
 class Environment:
     def __init__(self, config: Dict[str, Any], dataset: Optional[MarketDataset] = None):
         self.config = dict(config)
@@ -72,9 +93,17 @@ class Environment:
                 "input data is empty or too short for the configured window"
             )
 
-        feature_columns = list(config.get("feature_columns") or [])
-        binary_cols = set(config.get("feature_binary_columns") or [])
+        feature_columns = _parse_column_list(
+            config.get("feature_columns"), "feature_columns"
+        )
+        binary_cols = set(_parse_column_list(
+            config.get("feature_binary_columns"), "feature_binary_columns"
+        ))
         binary_mask = tuple(c in binary_cols for c in feature_columns)
+        # normalized forms back into the held config so every consumer
+        # (obs export, summaries) sees lists, not CLI JSON strings
+        self.config["feature_columns"] = feature_columns
+        self.config["feature_binary_columns"] = sorted(binary_cols)
 
         from gymfx_tpu.core.types import _parse_profile
 

@@ -1,0 +1,278 @@
+"""Pallas TPU kernel: fused single-pass attention for policy windows.
+
+VERDICT r4 weak #5: the transformer_ring policy's single-device path
+computed plain ``softmax(QK^T)V`` through XLA, which materializes the
+``(envs, heads, W, W)`` score tensor in HBM — at window 256 x 8192 envs
+that is ~4 GB of score traffic per forward, and the long-context bench
+row ran at 0.2x the per-chip target.  This kernel computes a BLOCK of
+envs' whole-window attention per program in a single VMEM-resident
+pass (flash-attention's insight specialized to policy windows:
+W <= 1024 means the full W x W score block FITS in VMEM, so no
+online-softmax streaming is needed — one exp, one normalize, zero HBM
+score traffic).
+
+Granularity matters twice here:
+  * env blocks (``_env_block``) amortize per-program overhead — one
+    program per (env, head) measured SLOWER than XLA (dispatch
+    overhead beats the HBM saving at 16k tiny programs);
+  * a ``jax.custom_batching.custom_vmap`` rule folds the trainers'
+    per-env ``vmap`` into the blocked kernel — pallas' default
+    batching rule would add a size-1 grid dimension per env and
+    recreate exactly the tiny-program problem.
+
+Numerics run in float32 inside the kernel regardless of the policy
+dtype, like XLA's f32 matmul accumulation on bf16 inputs.
+Differentiable: the backward recomputes through the plain-XLA twin
+(``parallel.ring_attention.full_attention``) and takes its gradient —
+the standard flash-attention recompute trade (no residual score
+tensor, extra forward FLOPs on the rarer update pass; the rollout /
+eval hot path is forward-only).
+
+Falls back to pallas interpret mode off-TPU, so tests run on CPU; the
+plain-XLA twin remains the parity oracle and the >1024-window fallback.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# beyond this window the W x W f32 score blocks (plus q/k/v) stop
+# fitting comfortably in ~16 MB VMEM; longer sequences are the ring /
+# Ulysses backends' territory anyway (parallel/ring_attention.py)
+MAX_FUSED_WINDOW = 1024
+
+# below this window the kernel LOSES to XLA: at W=32 the measured A/B
+# on the v5e chip was 30.8k vs 145.9k env-steps/s — the per-program
+# work is tiny, and the (B,S,H,D)<->(B,H,S,D) transposes around the
+# call cost more than the (small) score tensors ever did.  The fused
+# path only pays off where score HBM traffic is the wall (W^2 scaling):
+# measured 1.43x op-level at W=256.  Callers (policies.py
+# dense_window_attention) route short windows to plain XLA.
+MIN_FUSED_WINDOW = 192
+
+
+def _env_block(batch: int, window: int, score_blocks_live: int = 1) -> int:
+    """Envs per program: amortize program overhead while keeping the
+    live f32 score blocks (score_blocks_live * eb * W * W * 4 bytes)
+    within a few MB of VMEM.  The backward pass holds three
+    score-shaped values at once (scores/p, dp, ds)."""
+    budget = max(
+        1, (4 * 1024 * 1024) // (score_blocks_live * window * window * 4)
+    )
+    for eb in (16, 8, 4, 2, 1):
+        if eb <= budget and batch % eb == 0:
+            return eb
+    return 1
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool):
+    q = q_ref[:, 0].astype(jnp.float32)   # (eb, S, D)
+    k = k_ref[:, 0].astype(jnp.float32)
+    v = v_ref[:, 0].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale                              # (eb, S, S)
+    if causal:
+        s = scores.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        scores = jnp.where((row >= col)[None], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    num = jax.lax.dot_general(
+        p, v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                      # (eb, S, D)
+    out = num / jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[:, 0] = out.astype(o_ref.dtype)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, g_ref, dq_ref, dk_ref, dv_ref, *,
+                scale: float, causal: bool):
+    """VMEM-resident attention backward: recompute the score block from
+    q/k (cheaper than ever writing it to HBM), then the standard
+    softmax-attention gradients — dV = P^T dO, dP = dO V^T,
+    dS = P (dP - rowsum(dP P)), dQ = scale dS K, dK = scale dS^T Q."""
+    q = q_ref[:, 0].astype(jnp.float32)   # (eb, S, D)
+    k = k_ref[:, 0].astype(jnp.float32)
+    v = v_ref[:, 0].astype(jnp.float32)
+    g = g_ref[:, 0].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        s = scores.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        scores = jnp.where((row >= col)[None], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)      # (eb, Sq, Sk)
+    dv = jax.lax.dot_general(
+        p, g, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                               # (eb, Sk, D)
+    dp = jax.lax.dot_general(
+        g, v, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                               # (eb, Sq, Sk)
+    delta = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jax.lax.dot_general(
+        ds, k, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    dk = jax.lax.dot_general(
+        ds, q, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    dq_ref[:, 0] = dq.astype(dq_ref.dtype)
+    dk_ref[:, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[:, 0] = dv.astype(dv_ref.dtype)
+
+
+def _backward_batched(q, k, v, g, causal: bool, interpret: bool):
+    """Fused backward on (B, S, H, D) primals + cotangent."""
+    b, s, h, d = q.shape
+    eb = _env_block(b, s, score_blocks_live=3)
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_bwd_kernel, scale=scale, causal=causal)
+    spec = pl.BlockSpec((eb, 1, s, d), lambda i, j: (i, j, 0, 0))
+    call = pl.pallas_call(
+        kernel,
+        grid=(b // eb, h),
+        in_specs=[spec] * 4,
+        out_specs=[spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), q.dtype)] * 3,
+        interpret=interpret,
+    )
+    sw = lambda x: jnp.swapaxes(x, 1, 2)  # noqa: E731
+    dq, dk, dv = call(sw(q), sw(k), sw(v), sw(g))
+    return sw(dq), sw(dk), sw(dv)
+
+
+def _forward_batched(q, k, v, causal: bool, interpret: bool):
+    """Fused pass on (B, S, H, D) inputs."""
+    b, s, h, d = q.shape
+    eb = _env_block(b, s)
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_kernel, scale=scale, causal=causal)
+    # (B, H, S, D) layout: heads and env blocks ride the grid; Mosaic
+    # requires the last two block dims to tile (8, 128) or span the
+    # array, so the (S, D) face stays whole
+    call = pl.pallas_call(
+        kernel,
+        grid=(b // eb, h),
+        in_specs=[pl.BlockSpec((eb, 1, s, d), lambda i, j: (i, j, 0, 0))] * 3,
+        out_specs=pl.BlockSpec((eb, 1, s, d), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=interpret,
+    )
+    out = call(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2)
+    )
+    return jnp.swapaxes(out, 1, 2)
+
+
+@functools.lru_cache(maxsize=None)
+def _make(causal: bool, interpret: bool):
+    from jax.custom_batching import custom_vmap
+
+    @jax.custom_vjp
+    def attend_batched(q, k, v):           # (B, S, H, D)
+        return _forward_batched(q, k, v, causal, interpret)
+
+    def fwd(q, k, v):
+        return attend_batched(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        return _backward_batched(q, k, v, g, causal, interpret)
+
+    attend_batched.defvjp(fwd, bwd)
+
+    @custom_vmap
+    def attend_raw(q, k, v):               # (S, H, D)
+        return _forward_batched(
+            q[None], k[None], v[None], causal, interpret
+        )[0]
+
+    @attend_raw.def_vmap
+    def _attend_vmap_rule(axis_size, in_batched, q, k, v):
+        if not all(in_batched):
+            # replicate any unbatched operand along the vmapped axis,
+            # each with its OWN trailing shape
+            q, k, v = (
+                x if bat else jnp.broadcast_to(x[None], (axis_size, *x.shape))
+                for x, bat in zip((q, k, v), in_batched)
+            )
+        return attend_batched(q, k, v), True
+
+    # the backward gets the same vmap-collapse treatment: without it,
+    # grad-of-vmap (the training update) would push the pallas backward
+    # through the default size-1-grid batching rule — the tiny-program
+    # regime the env blocks exist to avoid
+    @custom_vmap
+    def bwd_raw(q, k, v, g):               # (S, H, D)
+        dq, dk, dv = _backward_batched(
+            q[None], k[None], v[None], g[None], causal, interpret
+        )
+        return dq[0], dk[0], dv[0]
+
+    @bwd_raw.def_vmap
+    def _bwd_vmap_rule(axis_size, in_batched, q, k, v, g):
+        if not all(in_batched):
+            q, k, v, g = (
+                x if bat else jnp.broadcast_to(x[None], (axis_size, *x.shape))
+                for x, bat in zip((q, k, v, g), in_batched)
+            )
+        return (
+            _backward_batched(q, k, v, g, causal, interpret),
+            (True, True, True),
+        )
+
+    # custom_vmap alone does not support reverse AD; the outer
+    # custom_vjp makes every transform order work — vmap(attend) hits
+    # the collapse rule, grad(attend) and grad(vmap(attend)) hit the
+    # fused backward kernel
+    @jax.custom_vjp
+    def attend(q, k, v):
+        return attend_raw(q, k, v)
+
+    def afwd(q, k, v):
+        return attend(q, k, v), (q, k, v)
+
+    def abwd(res, g):
+        q, k, v = res
+        return bwd_raw(q, k, v, g)
+
+    attend.defvjp(afwd, abwd)
+    return attend, attend_batched
+
+
+def fused_window_attention(q, k, v, *, causal: bool = False,
+                           interpret: bool | None = None):
+    """Exact attention for (..., W, H, D) q/k/v with the score blocks
+    kept in VMEM.  Any leading batch dims (flattened into the kernel's
+    env-block grid).  Differentiable (XLA-recompute backward).  Returns
+    (..., W, H, D) in the input dtype."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    *batch, s, h, d = q.shape
+    if s > MAX_FUSED_WINDOW:
+        raise ValueError(
+            f"fused_window_attention holds whole {s}x{s} score blocks "
+            f"in VMEM; windows beyond {MAX_FUSED_WINDOW} belong to the "
+            "ring/Ulysses sequence-parallel backends"
+        )
+    attend, attend_batched = _make(bool(causal), bool(interpret))
+    if not batch:
+        return attend(q, k, v)
+    flat = lambda x: x.reshape(-1, s, h, d)  # noqa: E731
+    out = attend_batched(flat(q), flat(k), flat(v))
+    return out.reshape(*batch, s, h, d)

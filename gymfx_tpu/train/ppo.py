@@ -54,6 +54,13 @@ class PPOConfig(NamedTuple):
     policy: str = "mlp"
     policy_dtype: Any = jnp.float32
     policy_kwargs: Tuple[Tuple[str, Any], ...] = ()
+    # sample_permute: iid shuffle of all T*N samples per epoch (the
+    #   classic PPO treatment; a 2M-row random HBM gather at 32k envs).
+    # env_permute: permute ENVS, each minibatch holding whole (T, ...)
+    #   trajectories — contiguous large-granularity DMA, the standard
+    #   recurrent-PPO sequence minibatching; recommended for >=16k-env
+    #   batches where the sample gather goes HBM-bound (VERDICT r4 #4).
+    minibatch_scheme: str = "sample_permute"
 
 
 def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
@@ -77,6 +84,9 @@ def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
         policy_kwargs=tuple(
             (k, tuple(v) if isinstance(v, list) else v)
             for k, v in (config.get("policy_kwargs") or {}).items()
+        ),
+        minibatch_scheme=str(
+            config.get("ppo_minibatch_scheme", "sample_permute")
         ),
     )
 
@@ -102,6 +112,19 @@ class PPOTrainer:
         self.env = env
         self.pcfg = pcfg
         self.mesh = mesh
+        if pcfg.minibatch_scheme not in ("sample_permute", "env_permute"):
+            raise ValueError(
+                "ppo_minibatch_scheme must be 'sample_permute' or "
+                f"'env_permute', got {pcfg.minibatch_scheme!r}"
+            )
+        if (
+            pcfg.minibatch_scheme == "env_permute"
+            and pcfg.n_envs % pcfg.minibatches
+        ):
+            raise ValueError(
+                f"env_permute needs num_envs ({pcfg.n_envs}) divisible "
+                f"by ppo_minibatches ({pcfg.minibatches})"
+            )
         self._continuous = env.cfg.action_space_mode == "continuous"
         self.policy = make_trainer_policy(
             pcfg.policy, continuous=self._continuous,
@@ -337,15 +360,6 @@ class PPOTrainer:
         )
         advs, returns = self._gae(traj, last_value)
 
-        # flatten (T, N, ...) -> (T*N, ...)
-        n_total = pcfg.horizon * pcfg.n_envs
-        flat = {
-            "obs": traj["obs"].reshape(n_total, *traj["obs"].shape[2:]),
-            "action": traj["action"].reshape(n_total),
-            "logp": traj["logp"].reshape(n_total),
-            "adv": advs.reshape(n_total),
-            "ret": returns.reshape(n_total),
-        }
         # Stored-state recurrent replay: each step replays with the carry
         # it was collected under (R2D2-style stored state), so at the
         # first epoch the replayed log-probs equal the stored ones
@@ -353,21 +367,55 @@ class PPOTrainer:
         # go stale across epochs as params move, the standard stored-
         # state trade-off; IMPALA re-unrolls from scratch instead
         # (train/impala.py).
-        flat["pcarry"] = jax.tree.map(
-            lambda x: x.reshape(n_total, *x.shape[2:]), traj["pcarry"]
-        )
+        fields = {
+            "obs": traj["obs"],
+            "action": traj["action"],
+            "logp": traj["logp"],
+            "adv": advs,
+            "ret": returns,
+            "pcarry": traj["pcarry"],
+        }
+        n_total = pcfg.horizon * pcfg.n_envs
+        if pcfg.minibatch_scheme == "env_permute":
+            # permute ENVS; each minibatch gathers whole (T, ...)
+            # trajectories — contiguous blocks instead of a T*N-row
+            # random gather, the wide-batch HBM fix (VERDICT r4 #4) and
+            # the standard recurrent sequence-minibatching treatment
+            # (divisibility validated at construction)
+            source = jax.tree.map(
+                lambda x: jnp.swapaxes(x, 0, 1), fields
+            )
+            n_perm = pcfg.n_envs
+            mb = pcfg.n_envs // pcfg.minibatches
+
+            def take(idx):
+                return jax.tree.map(
+                    lambda x: x[idx].reshape(
+                        mb * pcfg.horizon, *x.shape[2:]
+                    ),
+                    source,
+                )
+        else:
+            # classic PPO: iid shuffle of all T*N samples per epoch
+            source = jax.tree.map(
+                lambda x: x.reshape(n_total, *x.shape[2:]), fields
+            )
+            n_perm = n_total
+            mb = n_total // pcfg.minibatches
+
+            def take(idx):
+                return jax.tree.map(lambda x: x[idx], source)
 
         params, opt_state = state.params, state.opt_state
-        mb = n_total // pcfg.minibatches
 
         def epoch_body(carry, k):
             params, opt_state = carry
-            perm = jax.random.permutation(k, n_total)
+            perm = jax.random.permutation(k, n_perm)
 
             def mb_body(carry, i):
                 params, opt_state = carry
                 idx = jax.lax.dynamic_slice_in_dim(perm, i * mb, mb)
-                batch = jax.tree.map(lambda x: x[idx], flat)
+                batch = take(idx)
                 (loss, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
                     params, batch
                 )

@@ -128,6 +128,56 @@ def test_record_then_replay_roundtrip(tmp_path):
     assert s2["action_diagnostics"]["long_actions"] == s1["action_diagnostics"]["long_actions"]
 
 
+def test_export_scaled_features_via_kernel_matches_obs_semantics(tmp_path):
+    """--export_scaled_features materializes the episode's scaled
+    feature windows through the pallas kernel's product path (VERDICT
+    r4 weak #4): values must equal the reference implementation, with
+    binary columns passed through raw like the obs path."""
+    out = tmp_path / "features.npz"
+    summary, _ = _run(
+        tmp_path, SAMPLE, "--driver_mode", "flat",
+        "--feature_columns", '["CLOSE", "VOLUME"]',
+        "--feature_binary_columns", '["VOLUME"]',
+        "--window_size", "8",
+        "--export_scaled_features", str(out),
+    )
+    meta = summary["export_scaled_features"]
+    assert meta["shape"] == [120, 8, 2]
+    assert meta["columns"] == ["CLOSE", "VOLUME"]
+    data = np.load(out, allow_pickle=False)
+    arr = data["scaled_windows"]
+    assert list(data["feature_columns"]) == ["CLOSE", "VOLUME"]
+
+    # parity with the reference scaler + raw binary passthrough
+    import jax.numpy as jnp
+
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.ops.window_zscore import reference_scaled_windows
+
+    config = dict(DEFAULT_VALUES)
+    config.update(input_data_file=SAMPLE, window_size=8,
+                  feature_columns=["CLOSE", "VOLUME"],
+                  feature_binary_columns=["VOLUME"])
+    env = Environment(config)
+    steps = jnp.arange(1, 121, dtype=jnp.int32)
+    ref = np.asarray(reference_scaled_windows(
+        env.data.padded_features, env.data.feat_mean, env.data.feat_std,
+        env.data.feat_neutral, steps, window=8,
+        clip=float(env.cfg.feature_clip or 0.0),
+    ))
+    raw = np.asarray(env.data.padded_features)
+    np.testing.assert_allclose(arr[:, :, 0], ref[:, :, 0], atol=1e-5)
+    for i, s in enumerate(range(1, 121)):        # binary col: raw values
+        np.testing.assert_allclose(arr[i, :, 1], raw[s:s + 8, 1], atol=1e-6)
+
+
+def test_export_scaled_features_requires_feature_columns(tmp_path):
+    with pytest.raises(ValueError, match="feature_columns"):
+        _run(tmp_path, SAMPLE, "--driver_mode", "flat",
+             "--export_scaled_features", str(tmp_path / "f.npz"))
+
+
 def test_batch_evaluation_aggregates_over_envs(tmp_path):
     s = main(["--input_data_file", SAMPLE, "--driver_mode", "random",
               "--seed", "3", "--steps", "60", "--num_envs", "8",

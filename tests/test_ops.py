@@ -66,3 +66,75 @@ def test_neutral_steps_produce_zero_windows():
         window=w, interpret=True,
     )
     np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# fused window attention (ops/fused_attention.py, VERDICT r4 weak #5)
+# ---------------------------------------------------------------------------
+def _qkv(shape, seed=0):
+    import jax
+
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, shape, np.float32) for k in ks)
+
+
+@pytest.mark.parametrize("shape,causal", [
+    ((256, 4, 32), False),
+    ((64, 4, 32), True),
+    ((8, 128, 4, 32), False),   # leading env-batch dim (vmap rule)
+])
+def test_fused_attention_matches_reference(shape, causal):
+    from gymfx_tpu.ops.fused_attention import fused_window_attention
+    from gymfx_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = _qkv(shape)
+    ours = fused_window_attention(q, k, v, causal=causal, interpret=True)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=2e-6)
+
+
+def test_fused_attention_gradients_match_reference():
+    """The custom VJP (pallas forward, XLA-recompute backward) must
+    produce the reference gradients — the kernel is on the TRAINING
+    path of the transformer policies."""
+    import jax
+    import jax.numpy as jnp
+
+    from gymfx_tpu.ops.fused_attention import fused_window_attention
+    from gymfx_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = _qkv((32, 2, 16), seed=3)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(
+            fused_window_attention(q, k, v, interpret=True) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v) ** 2)
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_fused_attention_refuses_oversized_windows():
+    from gymfx_tpu.ops.fused_attention import fused_window_attention
+
+    q, k, v = _qkv((2048, 1, 8))
+    with pytest.raises(ValueError, match="ring/Ulysses"):
+        fused_window_attention(q, k, v, interpret=True)
+
+
+def test_dense_window_attention_dispatch_off_tpu_is_reference():
+    """On non-TPU backends the policies' dense attention is the XLA
+    twin exactly (the pallas path is TPU-only + interpret tests)."""
+    from gymfx_tpu.parallel.ring_attention import full_attention
+    from gymfx_tpu.train.policies import dense_window_attention
+
+    q, k, v = _qkv((16, 2, 8))
+    np.testing.assert_array_equal(
+        np.asarray(dense_window_attention(q, k, v)),
+        np.asarray(full_attention(q, k, v)),
+    )
