@@ -192,7 +192,7 @@ def _export_scaled_features(env, config, n_steps: int, path: str):
     (ops/window_zscore.py batched_scaled_windows): the IN-SCAN path
     keeps the O(1)-per-step streaming carry (cheaper than any batched
     materialization inside the episode), while this BATCHED
-    materialization — many steps at once — is the kernel's shape, 1.7x
+    materialization — many steps at once — is the kernel's shape, ~1.6x
     the jitted-XLA twin on chip (examples/results/
     pallas_kernel_bench.json)."""
     import jax

@@ -7,6 +7,7 @@ Runs the artifact generators in sequence (each is also runnable alone):
   tools/scan_bench.py         -> examples/results/tpu_scan_bench.json
   tools/pallas_bench.py       -> examples/results/pallas_kernel_bench.json
   tools/train_to_sharpe.py    -> examples/results/tpu_train_to_sharpe.json
+  tools/optimize_evidence.py  -> examples/results/tpu_optimize_atr.json
   tools/baseline_configs.py   -> examples/results/baseline_configs.json
 
 plus `bench.py` for the one-line headline (stdout only; the driver
@@ -32,6 +33,7 @@ GENERATORS = (
     ("tools/scan_bench.py", ["--quick"], []),
     ("tools/pallas_bench.py", ["--quick"], []),
     ("tools/train_to_sharpe.py", ["--quick"], []),
+    ("tools/optimize_evidence.py", ["--quick"], []),
     # baseline_configs writes its artifact even under --quick: redirect
     # the smoke output so CI runs can never clobber committed evidence
     ("tools/baseline_configs.py",

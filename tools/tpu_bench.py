@@ -282,19 +282,25 @@ def main() -> int:
             "materially means changing the training config (epochs / "
             "model width), not the attention kernel."
         )
-    split_rows = [r for r in rows if r.get("wall_split")]
+    # the rollover narrative compares MLP widths only — other policies'
+    # wall splits (e.g. the long-window transformer row) tell different
+    # stories and carry their own notes
+    split_rows = [
+        r for r in rows
+        if r.get("wall_split") and r["policy"] == "mlp" and r["window"] == 32
+    ]
     if len(split_rows) >= 2:
         segs = []
         for r in split_rows:
             w = r["wall_split"]
             samples = r["n_envs"] * r["horizon"]
             scheme = r.get("minibatch_scheme", "sample_permute")
+            rate = samples / max(w["update_seconds_per_iter"], 1e-9)
             segs.append(
                 f"{r['n_envs']} envs ({scheme}): rollout "
                 f"{w['rollout_seconds_per_iter']*1e3:.1f}ms, "
                 f"update {w['update_seconds_per_iter']*1e3:.1f}ms "
-                f"({samples / max(w['update_seconds_per_iter'], 1e-9) / 1e6:.1f}M "
-                "minibatch samples/s)"
+                f"({rate / 1e6:.2f}M minibatch samples/s)"
             )
         notes["batch_width_rollover"] = (
             "under the classic sample_permute scheme, wider-than-sweet-"
