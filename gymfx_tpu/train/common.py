@@ -161,6 +161,57 @@ def eval_checkpointed_policy(
     return summary
 
 
+def validate_minibatch_scheme(scheme: str, n_envs: int, minibatches: int) -> None:
+    """Construction-time validation shared by the PPO trainers."""
+    if scheme not in ("sample_permute", "env_permute"):
+        raise ValueError(
+            "ppo_minibatch_scheme must be 'sample_permute' or "
+            f"'env_permute', got {scheme!r}"
+        )
+    if scheme == "env_permute" and n_envs % minibatches:
+        raise ValueError(
+            f"env_permute needs num_envs ({n_envs}) divisible by "
+            f"ppo_minibatches ({minibatches})"
+        )
+
+
+def minibatch_plan(fields, *, scheme: str, n_envs: int, horizon: int,
+                   minibatches: int):
+    """One definition of the PPO update's minibatching schemes, shared
+    by the single-pair and portfolio trainers: returns
+    ``(n_perm, take)`` where a per-epoch permutation of ``n_perm``
+    indices is sliced into ``minibatches`` chunks and ``take(idx)``
+    materializes one flat minibatch from the (T, N, ...) ``fields``.
+
+      sample_permute  classic iid shuffle of all T*N samples;
+      env_permute     permute ENVS, minibatches gather whole (T, ...)
+                      trajectories — contiguous DMA, the wide-batch
+                      HBM fix (VERDICT r4 #4) and the standard
+                      recurrent sequence-minibatch treatment.
+    """
+    if scheme == "env_permute":
+        source = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), fields)
+        mb = n_envs // minibatches
+
+        def take(idx):
+            return jax.tree.map(
+                lambda x: x[idx].reshape(mb * horizon, *x.shape[2:]),
+                source,
+            )
+
+        return n_envs, take
+
+    n_total = horizon * n_envs
+    source = jax.tree.map(
+        lambda x: x.reshape(n_total, *x.shape[2:]), fields
+    )
+
+    def take(idx):
+        return jax.tree.map(lambda x: x[idx], source)
+
+    return n_total, take
+
+
 def masked_reset(done, fresh_tree, cur_tree):
     """Where ``done`` (batch bool), replace each leaf of ``cur_tree``
     with the (broadcast) corresponding leaf of ``fresh_tree``.  Used for

@@ -268,6 +268,9 @@ def make_portfolio_pbt(config: Dict[str, Any], pbt: PBTConfig,
         minibatches=int(config.get("ppo_minibatches", 4)),
         lr=float(config.get("learning_rate", 3e-4)),
         policy=str(config.get("policy") or "mlp"),
+        minibatch_scheme=str(
+            config.get("ppo_minibatch_scheme", "sample_permute")
+        ),
     )
     return PBTTrainer(env, None, pbt, core=_PBTPortfolioCore(env, pcfg),
                       mesh=mesh)

@@ -120,6 +120,9 @@ class PortfolioPPOConfig(NamedTuple):
     vf_coef: float = 0.5
     max_grad_norm: float = 0.5
     policy: str = "mlp"  # mlp | transformer | transformer_ring | transformer_ulysses
+    # sample_permute | env_permute — the same schemes as the single-pair
+    # trainer (train/ppo.py PPOConfig.minibatch_scheme)
+    minibatch_scheme: str = "sample_permute"
 
 
 class PortfolioTrainState(NamedTuple):
@@ -157,6 +160,11 @@ class PortfolioPPOTrainer:
         self.env = env
         self.pcfg = pcfg
         self.mesh = mesh
+        from gymfx_tpu.train.common import validate_minibatch_scheme
+
+        validate_minibatch_scheme(
+            pcfg.minibatch_scheme, pcfg.n_envs, pcfg.minibatches
+        )
         n_pairs = env.cfg.n_pairs
         if pcfg.policy == "transformer":
             self.policy = PortfolioTransformerPolicy(n_pairs=n_pairs)
@@ -313,25 +321,30 @@ class PortfolioPPOTrainer:
             state.params, state.env_states, state.obs_vec, state.rng
         )
         advs, returns = self._gae(traj, bootstrap)
-        n_total = pcfg.horizon * pcfg.n_envs
-        flat = {
-            "obs": traj["obs"].reshape(n_total, *traj["obs"].shape[2:]),
-            "action": traj["action"].reshape(n_total, -1),
-            "logp": traj["logp"].reshape(n_total),
-            "adv": advs.reshape(n_total),
-            "ret": returns.reshape(n_total),
+        fields = {
+            "obs": traj["obs"],
+            "action": traj["action"],
+            "logp": traj["logp"],
+            "adv": advs,
+            "ret": returns,
         }
+        from gymfx_tpu.train.common import minibatch_plan
+
+        n_perm, take = minibatch_plan(
+            fields, scheme=pcfg.minibatch_scheme, n_envs=pcfg.n_envs,
+            horizon=pcfg.horizon, minibatches=pcfg.minibatches,
+        )
+        mb = n_perm // pcfg.minibatches
         params, opt_state = state.params, state.opt_state
-        mb = n_total // pcfg.minibatches
 
         def epoch_body(carry, k):
             params, opt_state = carry
-            perm = jax.random.permutation(k, n_total)
+            perm = jax.random.permutation(k, n_perm)
 
             def mb_body(carry, i):
                 params, opt_state = carry
                 idx = jax.lax.dynamic_slice_in_dim(perm, i * mb, mb)
-                batch = jax.tree.map(lambda x: x[idx], flat)
+                batch = take(idx)
                 (loss, aux), grads = jax.value_and_grad(
                     self._loss, has_aux=True
                 )(params, batch)
@@ -507,6 +520,9 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         minibatches=int(config.get("ppo_minibatches", 4)),
         lr=float(config.get("learning_rate", 3e-4)),
         policy=str(config.get("policy") or "mlp"),
+        minibatch_scheme=str(
+            config.get("ppo_minibatch_scheme", "sample_permute")
+        ),
     )
     from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
 

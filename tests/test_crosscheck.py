@@ -284,6 +284,30 @@ def test_slippage_switch_combinations_reconcile(slip_open, slip_limit, slip_matc
     assert result["within_bound"], (slip_open, slip_limit, slip_match, result)
 
 
+def test_slip_match_under_venue_quantization_crosschecks():
+    """The in-bar snap twins (core/broker.py snap_in_bar and
+    simulation/replay.py snap_price_in_bar) must agree END-TO-END:
+    slip_match + venue quantization + nonzero slippage, bracketed
+    episode, both engines within the (collapsed, quantized) bound."""
+    result = crosscheck_episode(
+        _config(
+            driver_mode="random",
+            steps=300,
+            strategy_plugin="direct_fixed_sltp",
+            sl_pips=10.0,
+            tp_pips=20.0,
+            slippage_perc=2e-5,
+            slip_open=True,
+            slip_limit=True,
+            slip_match=True,
+            venue_quantization=True,
+        ),
+        seed=5,
+    )
+    assert result["replay_fills"] > 20
+    assert result["within_bound"], result
+
+
 def test_continuous_action_mode_reconciles():
     """Continuous mode works through the decision stream — the pending
     orders record the thresholded intents, not the raw floats."""

@@ -19,6 +19,32 @@ def _env(**over):
     return PortfolioEnvironment(config)
 
 
+def test_portfolio_env_permute_scheme_trains():
+    """The trajectory-minibatch scheme is shared with the single-pair
+    trainer (train/ppo.py): the portfolio trainer accepts it, trains
+    with finite losses, and validates divisibility at construction."""
+    import jax.numpy as jnp
+
+    from gymfx_tpu.train.portfolio_ppo import (
+        PortfolioPPOConfig,
+        PortfolioPPOTrainer,
+    )
+
+    env = _env()
+    tr = PortfolioPPOTrainer(
+        env, PortfolioPPOConfig(n_envs=4, horizon=8, epochs=1,
+                                minibatches=2,
+                                minibatch_scheme="env_permute"),
+    )
+    s, m = tr.train_step(tr.init_state(0))
+    assert jnp.isfinite(m["loss"])
+    with pytest.raises(ValueError, match="divisible"):
+        PortfolioPPOTrainer(
+            env, PortfolioPPOConfig(n_envs=4, minibatches=3,
+                                    minibatch_scheme="env_permute"),
+        )
+
+
 def test_loads_and_aligns_three_pairs():
     env = _env()
     assert env.cfg.n_pairs == 3
