@@ -179,9 +179,10 @@ def minibatch_plan(fields, *, scheme: str, n_envs: int, horizon: int,
                    minibatches: int):
     """One definition of the PPO update's minibatching schemes, shared
     by the single-pair and portfolio trainers: returns
-    ``(n_perm, take)`` where a per-epoch permutation of ``n_perm``
-    indices is sliced into ``minibatches`` chunks and ``take(idx)``
-    materializes one flat minibatch from the (T, N, ...) ``fields``.
+    ``(n_perm, mb, take)`` where a per-epoch permutation of
+    ``n_perm`` indices is sliced into ``minibatches`` chunks of ``mb``
+    indices each, and ``take(idx)`` materializes one flat minibatch
+    from the (T, N, ...) ``fields``.
 
       sample_permute  classic iid shuffle of all T*N samples;
       env_permute     permute ENVS, minibatches gather whole (T, ...)
@@ -199,7 +200,7 @@ def minibatch_plan(fields, *, scheme: str, n_envs: int, horizon: int,
                 source,
             )
 
-        return n_envs, take
+        return n_envs, mb, take
 
     n_total = horizon * n_envs
     source = jax.tree.map(
@@ -209,7 +210,7 @@ def minibatch_plan(fields, *, scheme: str, n_envs: int, horizon: int,
     def take(idx):
         return jax.tree.map(lambda x: x[idx], source)
 
-    return n_total, take
+    return n_total, n_total // minibatches, take
 
 
 def masked_reset(done, fresh_tree, cur_tree):

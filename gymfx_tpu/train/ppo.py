@@ -369,11 +369,10 @@ class PPOTrainer:
         }
         from gymfx_tpu.train.common import minibatch_plan
 
-        n_perm, take = minibatch_plan(
+        n_perm, mb, take = minibatch_plan(
             fields, scheme=pcfg.minibatch_scheme, n_envs=pcfg.n_envs,
             horizon=pcfg.horizon, minibatches=pcfg.minibatches,
         )
-        mb = n_perm // pcfg.minibatches
         params, opt_state = state.params, state.opt_state
 
         def epoch_body(carry, k):

@@ -9,6 +9,8 @@ Runs the artifact generators in sequence (each is also runnable alone):
   tools/train_to_sharpe.py    -> examples/results/tpu_train_to_sharpe.json
   tools/optimize_evidence.py  -> examples/results/tpu_optimize_atr.json
   tools/baseline_configs.py   -> examples/results/baseline_configs.json
+  (full mode also refreshes: smoke summaries, scan_determinism,
+   engine_benchmark, bakeoff_evidence — writers with no --quick mode)
 
 plus `bench.py` for the one-line headline (stdout only; the driver
 captures it separately).  Each generator stamps date/device provenance,
@@ -38,6 +40,14 @@ GENERATORS = (
     # the smoke output so CI runs can never clobber committed evidence
     ("tools/baseline_configs.py",
      ["--quick", "--out", "/tmp/baseline_configs_quick.json"], []),
+    # the remaining evidence writers take no flags and ALWAYS write, so
+    # they run in full mode only (quick_flags=None -> skipped): the
+    # diagnostic summaries, determinism hashes, engine benchmark and
+    # bake-off evidence
+    ("tools/smoke_test.py", None, []),
+    ("tools/env_determinism.py", None, []),
+    ("tools/simulation_engine_benchmark.py", None, []),
+    ("tools/bakeoff.py", None, []),
 )
 
 
@@ -49,6 +59,10 @@ def main() -> int:
 
     failures = []
     for script, quick_flags, full_flags in GENERATORS:
+        if args.quick and quick_flags is None:
+            print(f"== {script} (skipped under --quick: always writes)",
+                  flush=True)
+            continue
         cmd = [sys.executable, str(REPO / script)]
         cmd += quick_flags if args.quick else full_flags
         print(f"== {' '.join(cmd[1:])}", flush=True)
