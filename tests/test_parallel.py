@@ -106,10 +106,15 @@ def test_sharded_vmapped_rollout_matches_unsharded():
     np.testing.assert_allclose(np.asarray(eq_ref), np.asarray(eq_sh), atol=1e-6)
 
 
-def test_ppo_train_step_on_mesh():
+import pytest
+
+
+@pytest.mark.parametrize("scheme", ["sample_permute", "env_permute"])
+def test_ppo_train_step_on_mesh(scheme):
     config = dict(DEFAULT_VALUES)
     config.update(window_size=8, timeframe="M1", num_envs=16, ppo_horizon=8,
                   ppo_epochs=1, ppo_minibatches=2,
+                  ppo_minibatch_scheme=scheme,
                   policy_kwargs={"hidden": [128, 128]})
     df = uptrend_df(60)
     env = Environment(config, dataset=MarketDataset(df, config))
