@@ -223,9 +223,17 @@ def _export_scaled_features(env, config, n_steps: int, path: str):
 
         raw = np.asarray(jax.device_get(data.padded_features), np.float32)
         steps_np = np.arange(1, n_steps + 1)
+        clip = float(cfg.feature_clip or 0.0)
         for j, is_bin in enumerate(cfg.binary_mask):
             if is_bin:
-                arr[:, :, j] = sliding_window_view(raw[:, j], w)[steps_np]
+                col = sliding_window_view(raw[:, j], w)[steps_np]
+                # match build_obs (core/obs.py): passthrough values still
+                # go through the clip + nan_to_num clamp
+                if clip > 0:
+                    col = np.clip(col, -clip, clip)
+                arr[:, :, j] = np.nan_to_num(
+                    col, nan=0.0, posinf=clip, neginf=-clip
+                )
     columns = [str(c) for c in (env.config.get("feature_columns") or [])]
     np.savez_compressed(
         path, scaled_windows=arr, feature_columns=np.asarray(columns)

@@ -51,4 +51,9 @@ def parse_args(argv=None):
     parser.add_argument("--checkpoint_dir", type=str)
     parser.add_argument("--train_total_steps", type=int)
 
+    # resilience flags (docs/resilience.md)
+    parser.add_argument("--checkpoint_every", type=int)
+    parser.add_argument("--fault_profile", type=str)
+    parser.add_argument("--guard_max_consecutive_skips", type=int)
+
     return parser.parse_known_args(argv)

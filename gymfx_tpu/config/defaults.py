@@ -76,4 +76,26 @@ DEFAULT_VALUES = {
     # pass --policy mlp|lstm|transformer|transformer_ring|
     # transformer_ulysses to override.
     "policy": None,
+
+    # ---- resilience (docs/resilience.md) ----
+    # in-jit non-finite guard on every train step: skip poisoned
+    # minibatches (keep last-good params/opt state) instead of
+    # propagating NaN into the weights
+    "nonfinite_guard": True,
+    # abort training after this many CONSECUTIVE fully-skipped steps
+    "guard_max_consecutive_skips": 10,
+    # preemption-safe periodic auto-checkpointing: save every N env
+    # steps into checkpoint_dir (0 = final save only)
+    "checkpoint_every": 0,
+    # deterministic fault-injection profile for chaos tests, e.g.
+    # "nan_bars=30-31;transport=http:503,http:503,ok;preempt_at=2;seed=7"
+    "fault_profile": None,
+    # live-path retry/backoff + circuit breaker (oanda_broker plugin)
+    "live_retry_max_attempts": 4,
+    "live_retry_base_delay": 0.25,
+    "live_retry_max_delay": 8.0,
+    "live_retry_timeout": 30.0,
+    "live_retry_budget": 64,
+    "live_breaker_threshold": 5,
+    "live_breaker_recovery_time": 30.0,
 }

@@ -26,6 +26,7 @@ Endpoints used (OANDA v20 public API):
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Callable, Dict, Optional
 
 PRACTICE_HOST = "https://api-fxpractice.oanda.com"
@@ -36,14 +37,14 @@ Transport = Callable[[str, str, Dict[str, str], Optional[bytes]], Any]
 
 
 def _urllib_transport(method: str, url: str, headers: Dict[str, str],
-                      body: Optional[bytes]):
+                      body: Optional[bytes], timeout: float = 30.0):
     import urllib.error
     import urllib.request
 
     req = urllib.request.Request(url, data=body, headers=headers,
                                  method=method)
     try:
-        with urllib.request.urlopen(req, timeout=30) as resp:  # nosec B310
+        with urllib.request.urlopen(req, timeout=timeout) as resp:  # nosec B310
             return resp.status, resp.read()
     except urllib.error.HTTPError as e:
         # non-2xx must flow back as (status, body) so _request raises the
@@ -58,17 +59,55 @@ class OandaApiError(RuntimeError):
         self.body = body
 
 
+class OandaTransportError(RuntimeError):
+    """The venue's response was unusable (e.g. truncated JSON) — the
+    request MAY have been processed, so callers must treat this like a
+    timeout: retry only through an idempotency-checked path."""
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Failures worth retrying / counting toward the circuit breaker:
+    5xx (venue-side), timeouts and connection drops (OSError covers
+    socket.timeout, ConnectionError and urllib's URLError), and
+    unusable response bodies.  4xx are the caller's bug — retrying
+    cannot fix them and they must not trip the breaker."""
+    if isinstance(exc, OandaApiError):
+        return exc.status >= 500
+    return isinstance(exc, (OSError, TimeoutError, OandaTransportError))
+
+
 class OandaLiveBroker:
     """Minimal v20 REST trading client.
 
     Quantities follow OANDA conventions: signed integer units (positive
     buys, negative sells); prices are decimal strings at the
     instrument's precision.
+
+    Resilience (all optional, default off so the bare client behaves
+    exactly as before):
+
+      ``retry_policy``  transient failures (5xx, timeout, connection
+          drop, truncated body) on IDEMPOTENT calls (GET) retry with
+          exponential backoff + jitter.  Non-idempotent calls (POST
+          orders, PUT close) are NEVER retried here — a lost response
+          does not mean an unprocessed order, so their retry belongs in
+          :class:`TargetOrderRouter`, whose per-attempt client-id lookup
+          makes the resubmit dedup-safe.
+      ``breaker``  a :class:`~gymfx_tpu.resilience.retry.CircuitBreaker`
+          gating every call; transient failures count toward the trip
+          threshold, 4xx do not (they are the caller's bug).  Emergency
+          calls (the router's flatten-and-halt) bypass it entirely.
+      ``retry_budget``  shared cross-call retry cap.
     """
 
     def __init__(self, token: str, account_id: str, *,
                  practice: bool = True,
-                 transport: Optional[Transport] = None):
+                 transport: Optional[Transport] = None,
+                 retry_policy: Optional[Any] = None,
+                 breaker: Optional[Any] = None,
+                 retry_budget: Optional[Any] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Optional[Any] = None):
         if not token or not account_id:
             raise ValueError("OandaLiveBroker requires token and account_id")
         self.account_id = account_id
@@ -77,19 +116,72 @@ class OandaLiveBroker:
             "Authorization": f"Bearer {token}",
             "Content-Type": "application/json",
         }
-        self._transport = transport or _urllib_transport
+        if transport is None:
+            timeout = float(getattr(retry_policy, "timeout", 30.0) or 30.0)
+            transport = lambda m, u, h, b: _urllib_transport(  # noqa: E731
+                m, u, h, b, timeout=timeout
+            )
+        self._transport = transport
+        self.retry_policy = retry_policy
+        self.breaker = breaker
+        self.retry_budget = retry_budget
+        self._sleep = sleep
+        self._rng = rng
 
     # ------------------------------------------------------------------
     def _request(self, method: str, path: str,
-                 payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+                 payload: Optional[Dict[str, Any]] = None, *,
+                 emergency: bool = False) -> Dict[str, Any]:
+        """One API call under the resilience wrappers.  ``emergency``
+        (the router's flatten-and-halt close) skips the circuit breaker
+        in BOTH directions — an open breaker must not block the flatten,
+        and the flatten's own failure must not re-trip it."""
         body = json.dumps(payload).encode() if payload is not None else None
-        status, raw = self._transport(
-            method, f"{self._base}{path}", dict(self._headers), body
-        )
-        text = raw.decode() if isinstance(raw, (bytes, bytearray)) else str(raw)
-        if not 200 <= int(status) < 300:
-            raise OandaApiError(int(status), text)
-        return json.loads(text) if text else {}
+        url = f"{self._base}{path}"
+
+        def attempt() -> Dict[str, Any]:
+            status, raw = self._transport(
+                method, url, dict(self._headers), body
+            )
+            text = (
+                raw.decode() if isinstance(raw, (bytes, bytearray)) else str(raw)
+            )
+            if not 200 <= int(status) < 300:
+                raise OandaApiError(int(status), text)
+            try:
+                return json.loads(text) if text else {}
+            except json.JSONDecodeError as e:
+                raise OandaTransportError(
+                    f"unusable response body from {method} {path}: {e}"
+                ) from e
+
+        breaker = None if emergency else self.breaker
+        if breaker is not None:
+            breaker.allow()
+        try:
+            if self.retry_policy is not None and method == "GET":
+                from gymfx_tpu.resilience.retry import RetryError, retry_call
+
+                try:
+                    result = retry_call(
+                        attempt, policy=self.retry_policy,
+                        retry_on_exc=_is_transient,
+                        budget=self.retry_budget,
+                        sleep=self._sleep, rng=self._rng,
+                    )
+                except RetryError as e:
+                    # surface the final underlying failure, same type
+                    # the unretried path would raise
+                    raise e.last from e
+            else:
+                result = attempt()
+        except BaseException as exc:
+            if breaker is not None and _is_transient(exc):
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return result
 
     # ------------------------------------------------------------------
     def account_summary(self) -> Dict[str, Any]:
@@ -168,7 +260,10 @@ class OandaLiveBroker:
         """The order previously submitted with ``clientExtensions.id``
         ``client_id`` in ANY state (pending, filled, cancelled), or
         ``None`` when the account has never seen that id — OANDA's
-        ``@``-prefixed orderSpecifier lookup."""
+        ``@``-prefixed orderSpecifier lookup, with the transactions
+        stream as the 404 fallback (some v20 builds 404 the @-lookup
+        for market orders that filled and left the order book; the
+        transaction log is the ground truth)."""
         from urllib.parse import quote
 
         try:
@@ -178,17 +273,56 @@ class OandaLiveBroker:
                 f"@{quote(str(client_id), safe='')}",
             ).get("order")
         except OandaApiError as e:
-            if e.status == 404:
-                return None
-            raise
+            if e.status != 404:
+                raise
+        return self._order_from_transactions(str(client_id))
+
+    def _order_from_transactions(self, client_id: str) -> Optional[Dict[str, Any]]:
+        """Best-effort reconstruction of an order's state from
+        ``GET .../transactions/sinceid``: matching MARKET_ORDER /
+        ORDER_FILL / ORDER_CANCEL transactions collapse into the
+        ``state`` field the router's dedup check reads.  Returns None
+        when the stream shows no trace of the id (never submitted) or
+        the fallback itself fails (the router then treats the decision
+        as unsubmitted — the same conclusion a plain 404 produced before
+        this fallback existed)."""
+        try:
+            data = self._request(
+                "GET",
+                f"/v3/accounts/{self.account_id}/transactions/sinceid?id=1",
+            )
+        except (OandaApiError, OandaTransportError):
+            return None
+        matches = []
+        for txn in data.get("transactions", []) or []:
+            ext_id = (txn.get("clientExtensions") or {}).get("id")
+            if client_id in (ext_id, txn.get("clientOrderID")):
+                matches.append(txn)
+        if not matches:
+            return None
+        types = {t.get("type") for t in matches}
+        if "ORDER_FILL" in types:
+            state = "FILLED"
+        elif "ORDER_CANCEL" in types:
+            state = "CANCELLED"
+        else:
+            state = "PENDING"
+        return {
+            "state": state,
+            "clientExtensions": {"id": client_id},
+            "transactions": matches,
+        }
 
     def close_position(self, instrument: str, *,
-                       client_id: Optional[str] = None) -> Dict[str, Any]:
+                       client_id: Optional[str] = None,
+                       emergency: bool = False) -> Dict[str, Any]:
         """Flatten the instrument (both sides, like the scan engine's
         force-flat).  ``client_id`` attaches to the venue-generated
         market order(s) so a retried flatten decision is discoverable
         via :meth:`order_by_client_id` (net positions only ever hold one
-        side, so the shared id cannot collide with itself)."""
+        side, so the shared id cannot collide with itself).
+        ``emergency`` bypasses the circuit breaker — the router's
+        flatten-and-halt must go out even when the breaker is open."""
         payload: Dict[str, Any] = {"longUnits": "ALL", "shortUnits": "ALL"}
         if client_id:
             ext = {"id": str(client_id)}
@@ -197,8 +331,14 @@ class OandaLiveBroker:
         return self._request(
             "PUT",
             f"/v3/accounts/{self.account_id}/positions/{instrument}/close",
-            payload,
+            payload, emergency=emergency,
         )
+
+
+class RouterHaltedError(RuntimeError):
+    """The router is in flatten-and-halt degraded mode (circuit breaker
+    tripped): it flattened the book (best-effort) and refuses further
+    submissions until a human (or supervisor process) resets it."""
 
 
 class TargetOrderRouter:
@@ -234,20 +374,69 @@ class TargetOrderRouter:
 
     def __init__(self, broker: OandaLiveBroker, instrument: str, *,
                  price_precision: int = 5,
-                 client_id_prefix: str = "gymfx"):
+                 client_id_prefix: str = "gymfx",
+                 retry_policy: Optional[Any] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Optional[Any] = None):
         self.broker = broker
         self.instrument = instrument
         self.price_precision = int(price_precision)
         self.client_id_prefix = str(client_id_prefix)
+        self.retry_policy = retry_policy
+        self._sleep = sleep
+        self._rng = rng
+        self.halted = False
+        self.halt_reason: Optional[str] = None
+        self.flatten_error: Optional[BaseException] = None
         import uuid
 
         self._session_tag = uuid.uuid4().hex[:8]
         self._decision_seq = 0
+        # the breaker (when the broker carries one) trips the router
+        # into flatten-and-halt; attach AFTER construction so the
+        # breaker can be shared/configured independently
+        if getattr(broker, "breaker", None) is not None:
+            broker.breaker.on_trip = self._flatten_and_halt
 
+    # ------------------------------------------------------------------
+    def _flatten_and_halt(self) -> None:
+        """Degraded mode: one best-effort emergency flatten (bypassing
+        the now-open breaker — it would refuse the flatten itself),
+        then refuse every further submission.  The flatten's own
+        failure is recorded, not raised: halting must always succeed."""
+        if self.halted:
+            return
+        self.halted = True
+        self.halt_reason = "circuit breaker tripped"
+        try:
+            self.broker.close_position(
+                self.instrument,
+                client_id=(
+                    f"{self.client_id_prefix}-{self.instrument}-halt-"
+                    f"{self._session_tag}"
+                ),
+                emergency=True,
+            )
+        except Exception as exc:  # noqa: BLE001 - recorded for operators
+            self.flatten_error = exc
+
+    def reset_halt(self) -> None:
+        """Operator acknowledgment: leave degraded mode (the breaker
+        still governs whether calls actually go through)."""
+        self.halted = False
+        self.halt_reason = None
+        self.flatten_error = None
+
+    # ------------------------------------------------------------------
     def submit_target(self, target_units: float, *,
                       stop_loss: Optional[float] = None,
                       take_profit: Optional[float] = None,
                       decision_id: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        if self.halted:
+            raise RouterHaltedError(
+                f"order router halted ({self.halt_reason}); book was "
+                "flattened — reset_halt() after resolving the outage"
+            )
         rounded_target = round(float(target_units))
         if abs(float(target_units) - rounded_target) > 1e-6:
             raise ValueError(
@@ -255,15 +444,59 @@ class TargetOrderRouter:
                 "OANDA units are integral; scale the kernel's sizing "
                 "before routing live (integral-units contract)"
             )
-        current = self.broker.open_positions().get(self.instrument, 0.0)
-        delta = rounded_target - current
-        if abs(delta) < 0.5:
-            return None
         explicit_decision = decision_id is not None
         if decision_id is None:
             self._decision_seq += 1
             decision_id = f"{self._session_tag}-{self._decision_seq}"
+            # under a retry policy the generated id is promoted to a
+            # real decision id: it is minted ONCE per call, so the
+            # retry attempts dedup against each other via the lookup
+            explicit_decision = self.retry_policy is not None
         client_id = f"{self.client_id_prefix}-{self.instrument}-{decision_id}"
+
+        def attempt() -> Optional[Dict[str, Any]]:
+            return self._submit_once(
+                rounded_target, client_id, explicit_decision,
+                stop_loss=stop_loss, take_profit=take_profit,
+            )
+
+        from gymfx_tpu.resilience.retry import CircuitOpenError
+
+        try:
+            if self.retry_policy is None:
+                return attempt()
+            from gymfx_tpu.resilience.retry import RetryError, retry_call
+
+            try:
+                # the WHOLE reconcile -> lookup -> submit sequence is
+                # the retry unit: re-reading positions and looking up
+                # the client id first is what makes resubmitting a
+                # non-idempotent order safe (a fill that happened but
+                # whose response was lost is found, not repeated)
+                return retry_call(
+                    attempt, policy=self.retry_policy,
+                    retry_on_exc=_is_transient,
+                    sleep=self._sleep, rng=self._rng,
+                )
+            except RetryError as e:
+                raise e.last from e
+        except CircuitOpenError as exc:
+            # the breaker's on_trip already flattened; a call landing
+            # on an ALREADY-open breaker still needs to surface halt
+            self._flatten_and_halt()
+            raise RouterHaltedError(
+                f"order router halted ({exc}); book was flattened — "
+                "reset_halt() after resolving the outage"
+            ) from exc
+
+    def _submit_once(self, rounded_target: int, client_id: str,
+                     explicit_decision: bool, *,
+                     stop_loss: Optional[float],
+                     take_profit: Optional[float]) -> Optional[Dict[str, Any]]:
+        current = self.broker.open_positions().get(self.instrument, 0.0)
+        delta = rounded_target - current
+        if abs(delta) < 0.5:
+            return None
         if explicit_decision:
             prior = self.broker.order_by_client_id(client_id)
             # a CANCELLED prior (FOK orders cancel routinely on missed

@@ -32,6 +32,14 @@ def default_broker(config):
         "oanda_account_id": None,
         "oanda_instrument": "EUR_USD",
         "oanda_practice": True,
+        # live-path resilience (docs/resilience.md)
+        "live_retry_max_attempts": 4,
+        "live_retry_base_delay": 0.25,
+        "live_retry_max_delay": 8.0,
+        "live_retry_timeout": 30.0,
+        "live_retry_budget": 64,
+        "live_breaker_threshold": 5,
+        "live_breaker_recovery_time": 30.0,
     },
 )
 def oanda_broker(config):
@@ -50,15 +58,52 @@ def oanda_broker(config):
     account = config.get("oanda_account_id") or os.environ.get("OANDA_ACCOUNT_ID")
     if not token or not account:
         raise ValueError("oanda_broker requires oanda_token and oanda_account_id")
-    from gymfx_tpu.live import OandaLiveBroker, TargetOrderRouter
+    import random
 
+    from gymfx_tpu.live import OandaLiveBroker, TargetOrderRouter
+    from gymfx_tpu.resilience import (
+        CircuitBreaker,
+        FlakyTransport,
+        RetryBudget,
+        RetryPolicy,
+        parse_fault_profile,
+    )
+
+    policy = RetryPolicy(
+        max_attempts=int(config.get("live_retry_max_attempts", 4)),
+        base_delay=float(config.get("live_retry_base_delay", 0.25)),
+        max_delay=float(config.get("live_retry_max_delay", 8.0)),
+        timeout=float(config.get("live_retry_timeout", 30.0)),
+    )
+    breaker = CircuitBreaker(
+        failure_threshold=int(config.get("live_breaker_threshold", 5)),
+        recovery_time=float(config.get("live_breaker_recovery_time", 30.0)),
+    )
+    transport = config.get("oanda_transport")  # tests inject a fake
+    profile = parse_fault_profile(config.get("fault_profile"))
+    if transport is not None and (
+        profile.get("transport_plan") or profile.get("transport_rate")
+    ):
+        # chaos mode: wrap the injected transport in a seeded flaky one
+        transport = FlakyTransport(
+            transport,
+            plan=profile.get("transport_plan") or (),
+            failure_rate=float(profile.get("transport_rate") or 0.0),
+            seed=int(profile.get("seed", 0)),
+        )
     broker = OandaLiveBroker(
         token, account,
         practice=bool(config.get("oanda_practice", True)),
-        transport=config.get("oanda_transport"),  # tests inject a fake
+        transport=transport,
+        retry_policy=policy,
+        breaker=breaker,
+        retry_budget=RetryBudget(int(config.get("live_retry_budget", 64))),
+        rng=random.Random(int(config.get("seed", 0))),
     )
     return TargetOrderRouter(
         broker,
         str(config.get("oanda_instrument", "EUR_USD")),
         price_precision=int(config.get("price_precision", 5)),
+        retry_policy=policy,
+        rng=random.Random(int(config.get("seed", 0)) + 1),
     )

@@ -1,0 +1,64 @@
+"""Resilience layer: non-finite train-step guards, retry/backoff +
+circuit breaker for the live path, and a deterministic fault-injection
+harness.
+
+Three pillars (ISSUE 1):
+
+  guards   in-graph ``jnp.isfinite`` reductions that skip a poisoned
+           update (keep last-good params/opt-state), quarantine-reset
+           contaminated envs, and abort loudly after N consecutive
+           fully-skipped steps (train/ppo.py, train/impala.py);
+  retry    generic exponential-backoff retry policy with jitter, retry
+           budget and per-call timeout, plus a circuit breaker that
+           trips the live order router into a flatten-and-halt
+           degraded mode (live/oanda.py);
+  faults   seeded injectors — flaky transports (timeouts, 5xx, partial
+           responses), NaN/inf feed contamination, simulated
+           preemption — usable in tests and via the ``fault_profile``
+           config knob for chaos runs.
+"""
+from gymfx_tpu.resilience.guards import (
+    NonFiniteDivergenceError,
+    SkipMonitor,
+    quarantine_mask,
+    select_tree,
+    tree_all_finite,
+)
+from gymfx_tpu.resilience.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudget,
+    RetryError,
+    RetryPolicy,
+    retry_call,
+)
+from gymfx_tpu.resilience.loop import ResilientLoop
+from gymfx_tpu.resilience.faults import (
+    FlakyTransport,
+    SimulatedPreemptionError,
+    apply_fault_profile_to_market_data,
+    contaminate_market_data,
+    nonfinite_report,
+    parse_fault_profile,
+)
+
+__all__ = [
+    "NonFiniteDivergenceError",
+    "SkipMonitor",
+    "quarantine_mask",
+    "select_tree",
+    "tree_all_finite",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "RetryBudget",
+    "RetryError",
+    "RetryPolicy",
+    "retry_call",
+    "ResilientLoop",
+    "FlakyTransport",
+    "SimulatedPreemptionError",
+    "apply_fault_profile_to_market_data",
+    "contaminate_market_data",
+    "nonfinite_report",
+    "parse_fault_profile",
+]

@@ -1,0 +1,281 @@
+"""Deterministic fault injection (resilience pillar 3).
+
+Everything here is seeded and replayable: the same profile string
+produces the same fault sequence on every run, so a chaos failure is a
+plain red test, not a flake.
+
+  FlakyTransport           wraps a live-path transport with a scripted
+                           fault plan (timeouts, connection drops, 5xx,
+                           accept-then-fail, truncated bodies);
+  contaminate_market_data  injects NaN/inf into feed windows (the bars
+                           AND the padded obs window, so both the
+                           reward path and the policy input see them);
+  SimulatedPreemptionError mid-run kill for checkpoint/resume drills;
+  parse_fault_profile      the ``fault_profile`` config-knob grammar.
+
+Profile grammar — semicolon-separated ``key=value`` clauses::
+
+    nan_bars=30-31;transport=http:503,http:503,ok;seed=7
+
+  nan_bars / inf_bars   bar indices to poison: ``N``, ``N-M`` (inclusive)
+                        or ``N,M,K`` (comma list within the clause is
+                        not supported — use multiple clauses or a range)
+  fields                comma-free ``+``-joined MarketData fields to
+                        poison (default ``close``)
+  transport             ``+``- or ``,``-joined fault tokens consumed one
+                        per HTTP call (see FAULT_TOKENS)
+  preempt_at            iteration index after which the trainer raises
+                        SimulatedPreemptionError (checkpoint drill)
+  seed                  seed for probabilistic plans (``transport=p0.3``)
+"""
+from __future__ import annotations
+
+import random
+import socket
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+FAULT_TOKENS = (
+    "ok",               # pass through untouched
+    "timeout",          # socket.timeout before the venue sees anything
+    "conn",             # ConnectionError before the venue sees anything
+    "http:<code>",      # synthesize an HTTP error; venue sees nothing
+    "accept-then-503",  # venue PROCESSES the call, response is lost as a
+                        # 503 — the case that distinguishes safe retry
+                        # (lookup-first) from double-fill (blind resubmit)
+    "partial",          # venue processes, body truncated mid-JSON
+)
+
+
+class SimulatedPreemptionError(RuntimeError):
+    """Injected mid-run kill: the trainer stops as if the TPU allocation
+    was preempted.  Carries the iteration index for the resume drill."""
+
+    def __init__(self, iteration: int):
+        super().__init__(
+            f"simulated preemption after iteration {iteration}; resume "
+            "from the latest auto-checkpoint"
+        )
+        self.iteration = int(iteration)
+
+
+class FlakyTransport:
+    """Deterministic flaky wrapper around a live-path transport.
+
+    ``plan`` is a sequence of fault tokens consumed one per call (calls
+    beyond the plan pass through); alternatively ``failure_rate`` draws
+    tokens from ``rate_tokens`` with a seeded RNG.  Matches the
+    ``Transport`` callable shape of ``live/oanda.py`` exactly, so it
+    drops into ``OandaLiveBroker(transport=...)`` and composes under the
+    retry layer.
+
+    The injected HTTP errors return OANDA-shaped ``errorMessage`` bodies
+    so the production error path (not a test-only one) handles them.
+    """
+
+    def __init__(
+        self,
+        inner: Callable[..., Any],
+        *,
+        plan: Sequence[str] = (),
+        failure_rate: float = 0.0,
+        rate_tokens: Sequence[str] = ("timeout", "http:503"),
+        seed: int = 0,
+        match: Optional[Callable[[str, str], bool]] = None,
+    ):
+        self._inner = inner
+        self._plan: List[str] = [str(t) for t in plan]
+        self._rate = float(failure_rate)
+        self._rate_tokens = tuple(rate_tokens)
+        self._rng = random.Random(seed)
+        self._match = match
+        self.calls = 0
+        self.faults_injected = 0
+        self.history: List[str] = []
+
+    def _next_token(self) -> str:
+        if self._plan:
+            return self._plan.pop(0)
+        if self._rate > 0.0 and self._rng.random() < self._rate:
+            return self._rng.choice(self._rate_tokens)
+        return "ok"
+
+    def __call__(self, method: str, url: str, headers: Dict[str, str],
+                 body: Optional[bytes]):
+        self.calls += 1
+        if self._match is not None and not self._match(method, url):
+            self.history.append("ok")
+            return self._inner(method, url, headers, body)
+        token = self._next_token()
+        self.history.append(token)
+        if token == "ok":
+            return self._inner(method, url, headers, body)
+        self.faults_injected += 1
+        if token == "timeout":
+            raise socket.timeout("injected transport timeout")
+        if token == "conn":
+            raise ConnectionError("injected connection failure")
+        if token.startswith("http:"):
+            code = int(token.split(":", 1)[1])
+            return code, (
+                b'{"errorMessage":"injected fault: HTTP %d"}' % code
+            )
+        if token == "accept-then-503":
+            # the venue processed the request; only the response is lost
+            self._inner(method, url, headers, body)
+            return 503, b'{"errorMessage":"injected fault: response lost"}'
+        if token == "partial":
+            status, raw = self._inner(method, url, headers, body)
+            text = raw if isinstance(raw, (bytes, bytearray)) else str(raw).encode()
+            return status, bytes(text)[: max(1, len(text) // 2)]
+        raise ValueError(f"unknown fault token {token!r}; known: {FAULT_TOKENS}")
+
+
+def contaminate_market_data(
+    data: Any,
+    *,
+    bars: Iterable[int],
+    fields: Sequence[str] = ("close",),
+    value: float = float("nan"),
+) -> Any:
+    """Poison ``bars`` of the named MarketData fields with ``value``
+    (NaN by default) and return the rebuilt MarketData.
+
+    Price fields are mirrored into ``padded_close`` at the shifted
+    offsets so BOTH consumption paths see the contamination: the reward
+    path reads ``close[t]`` and the obs window dynamic-slices
+    ``padded_close`` — poisoning only one would understate the blast
+    radius a real bad feed row has.
+    """
+    import jax.numpy as jnp
+
+    bar_idx = np.asarray(sorted(set(int(b) for b in bars)), dtype=np.int64)
+    if bar_idx.size == 0:
+        return data
+    n = int(np.asarray(data.close).shape[0])
+    if bar_idx.min() < 0 or bar_idx.max() >= n:
+        raise ValueError(
+            f"fault bars {bar_idx.min()}..{bar_idx.max()} out of range "
+            f"for a {n}-bar dataset"
+        )
+    replace: Dict[str, Any] = {}
+    for field in fields:
+        arr = np.asarray(getattr(data, field)).copy()
+        arr[bar_idx, ...] = value
+        replace[field] = jnp.asarray(arr, dtype=getattr(data, field).dtype)
+        if field == "close":
+            padded = np.asarray(data.padded_close).copy()
+            pad = padded.shape[0] - n
+            padded[bar_idx + pad] = value
+            replace["padded_close"] = jnp.asarray(
+                padded, dtype=data.padded_close.dtype
+            )
+    return data._replace(**replace)
+
+
+def nonfinite_report(data: Any) -> Dict[str, int]:
+    """Host-side diagnostic: count of non-finite values per floating
+    MarketData field (all zeros on a clean feed).  Cheap enough to run
+    once at load time; the guard metrics point here when they fire."""
+    out: Dict[str, int] = {}
+    for field, arr in zip(type(data)._fields, data):
+        host = np.asarray(arr)
+        if not np.issubdtype(host.dtype, np.inexact):
+            continue
+        bad = int((~np.isfinite(host)).sum())
+        if bad:
+            out[field] = bad
+    return out
+
+
+def _parse_bars(spec: str) -> List[int]:
+    spec = spec.strip()
+    if "-" in spec:
+        lo, hi = spec.split("-", 1)
+        return list(range(int(lo), int(hi) + 1))
+    return [int(spec)]
+
+
+def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
+    """Parse the ``fault_profile`` config string (grammar in the module
+    docstring) into a plain dict::
+
+        {"nan_bars": [...], "inf_bars": [...], "fields": [...],
+         "transport_plan": [...], "transport_rate": float,
+         "preempt_at": int|None, "seed": int}
+
+    Empty/None spec parses to an all-inert profile; unknown clause keys
+    raise (a typo'd chaos knob must not silently run a clean baseline).
+    """
+    profile: Dict[str, Any] = {
+        "nan_bars": [],
+        "inf_bars": [],
+        "fields": ["close"],
+        "transport_plan": [],
+        "transport_rate": 0.0,
+        "preempt_at": None,
+        "seed": 0,
+    }
+    if not spec:
+        return profile
+    for clause in str(spec).split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise ValueError(
+                f"fault_profile clause {clause!r} is not key=value"
+            )
+        key, val = (part.strip() for part in clause.split("=", 1))
+        if key == "nan_bars":
+            profile["nan_bars"].extend(_parse_bars(val))
+        elif key == "inf_bars":
+            profile["inf_bars"].extend(_parse_bars(val))
+        elif key == "fields":
+            profile["fields"] = [
+                f for f in val.replace("+", ",").split(",") if f
+            ]
+        elif key == "transport":
+            if val.startswith("p") and _is_float(val[1:]):
+                profile["transport_rate"] = float(val[1:])
+            else:
+                profile["transport_plan"] = [
+                    t for t in val.replace("+", ",").split(",") if t
+                ]
+        elif key == "preempt_at":
+            profile["preempt_at"] = int(val)
+        elif key == "seed":
+            profile["seed"] = int(val)
+        else:
+            raise ValueError(
+                f"unknown fault_profile key {key!r}; known: nan_bars, "
+                "inf_bars, fields, transport, preempt_at, seed"
+            )
+    return profile
+
+
+def apply_fault_profile_to_market_data(data: Any, profile: Dict[str, Any]) -> Any:
+    """Apply the feed-contamination part of a parsed profile (transport
+    and preemption faults are wired where those subsystems live)."""
+    if profile.get("nan_bars"):
+        data = contaminate_market_data(
+            data, bars=profile["nan_bars"],
+            fields=tuple(profile.get("fields", ("close",))),
+            value=float("nan"),
+        )
+    if profile.get("inf_bars"):
+        data = contaminate_market_data(
+            data, bars=profile["inf_bars"],
+            fields=tuple(profile.get("fields", ("close",))),
+            value=float("inf"),
+        )
+    return data
+
+
+def _is_float(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False

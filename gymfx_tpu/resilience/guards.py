@@ -1,0 +1,146 @@
+"""Non-finite train-step guards (resilience pillar 1).
+
+A single NaN/inf — a poisoned feed window, an exploding gradient, a
+bf16 overflow — silently corrupts a PPO/IMPALA train state forever:
+Adam moments go NaN and every later step inherits them.  The guards
+here keep long runs alive:
+
+  in-graph   ``tree_all_finite`` reductions decide per update whether
+             the loss/grads are usable; ``select_tree`` keeps the
+             last-good params/opt-state when they are not (the
+             ``lax.cond``-style skip, traced once, no host round trip);
+  per-env    ``quarantine_mask`` finds envs whose trajectory produced
+             non-finite values so the trainer can auto-reset exactly
+             those (a contaminated env would otherwise carry NaN equity
+             into every future rollout);
+  host-side  ``SkipMonitor`` counts consecutive fully-skipped steps and
+             aborts with a diagnostic instead of burning a TPU
+             allocation on a run that stopped learning.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _finite_leaves(tree: Any):
+    return [
+        x
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+    ]
+
+
+def tree_all_finite(tree: Any):
+    """Scalar bool: every element of every floating leaf is finite.
+    Integer/bool leaves are ignored (they cannot hold NaN).  Traceable —
+    this is the in-jit reduction the guarded updates branch on."""
+    leaves = _finite_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+
+
+def select_tree(pred, new_tree: Any, old_tree: Any) -> Any:
+    """Per-leaf ``where(pred, new, old)`` with a scalar ``pred`` — the
+    skip primitive: when ``pred`` is True the update is taken, when
+    False the last-good tree is kept bit-for-bit.  Equivalent to
+    ``lax.cond`` on pytrees but scan-carry friendly (both branches are
+    already materialized by the caller)."""
+    return jax.tree.map(lambda n, o: jnp.where(pred, n, o), new_tree, old_tree)
+
+
+def quarantine_mask(tree: Any, *, env_axis: int = 1, mode: str = "nonfinite"):
+    """Per-env poison mask over a trajectory pytree of ``(T, N, ...)``
+    arrays (time-major, env axis 1): True where ANY value belonging to
+    that env is poisoned.  The trainer resets exactly those envs to a
+    fresh episode — without this, one NaN bar sticks in the env's
+    accumulated equity and poisons every subsequent rollout.
+
+    ``mode='nonfinite'`` flags NaN and ±inf (right for trajectory
+    outputs — rewards/obs/log-probs are never legitimately infinite);
+    ``mode='nan'`` flags NaN only — required for carried env state,
+    whose peak/min/max trackers hold ±inf SENTINELS by design
+    (core/types.py) that must not trigger a reset."""
+    if mode == "nonfinite":
+        is_bad = lambda x: ~jnp.isfinite(x)  # noqa: E731
+    elif mode == "nan":
+        is_bad = jnp.isnan
+    else:
+        raise ValueError(f"mode must be 'nonfinite' or 'nan', got {mode!r}")
+    masks = []
+    for x in _finite_leaves(tree):
+        bad = is_bad(x)
+        axes = tuple(i for i in range(bad.ndim) if i != env_axis)
+        masks.append(bad.any(axis=axes))
+    if not masks:
+        raise ValueError("quarantine_mask needs at least one floating leaf")
+    out = masks[0]
+    for m in masks[1:]:
+        out = out | m
+    return out
+
+
+class NonFiniteDivergenceError(RuntimeError):
+    """Training diverged: every update in N consecutive steps was
+    non-finite.  Carries the last metrics snapshot for the post-mortem."""
+
+    def __init__(self, message: str, metrics: Optional[Dict[str, Any]] = None):
+        super().__init__(message)
+        self.metrics = dict(metrics or {})
+
+
+class SkipMonitor:
+    """Host-side divergence watchdog for the trainer loops.
+
+    ``update(metrics)`` after every train step; a step whose skipped
+    update count reaches its total update count (``nonfinite_skips`` >=
+    ``guard_updates``) advances the consecutive counter, any usable
+    step resets it, and ``max_consecutive`` fully-skipped steps in a
+    row raise :class:`NonFiniteDivergenceError` with a diagnostic —
+    params are provably stale at that point, so continuing only burns
+    the allocation.
+    """
+
+    def __init__(self, max_consecutive: int = 10):
+        if int(max_consecutive) < 1:
+            raise ValueError(
+                f"max_consecutive must be >= 1, got {max_consecutive}"
+            )
+        self.max_consecutive = int(max_consecutive)
+        self.consecutive = 0
+        self.total_skips = 0
+        self.total_poisoned_env_resets = 0
+
+    def update(self, metrics: Dict[str, Any], *, step: Optional[int] = None) -> None:
+        skips = int(metrics.get("nonfinite_skips", 0))
+        total = int(metrics.get("guard_updates", 0))
+        self.total_skips += skips
+        self.total_poisoned_env_resets += int(
+            metrics.get("poisoned_env_resets", 0)
+        )
+        if total > 0 and skips >= total:
+            self.consecutive += 1
+        else:
+            self.consecutive = 0
+        if self.consecutive >= self.max_consecutive:
+            at = f" at iteration {step}" if step is not None else ""
+            raise NonFiniteDivergenceError(
+                f"training diverged{at}: all {total} updates were "
+                f"non-finite for {self.consecutive} consecutive steps "
+                f"({self.total_skips} updates skipped in total, "
+                f"{self.total_poisoned_env_resets} envs quarantine-reset); "
+                "params/opt-state are the last finite values — inspect "
+                "the data feed for NaN/inf contamination or lower the "
+                "learning rate, then resume from the latest checkpoint",
+                metrics={k: _to_float(v) for k, v in metrics.items()},
+            )
+
+
+def _to_float(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return v

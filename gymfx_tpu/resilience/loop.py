@@ -1,0 +1,118 @@
+"""Host-side per-iteration resilience hooks shared by the trainer loops.
+
+The jitted train steps carry the in-graph guards (guards.py); this
+module is the thin host loop around them:
+
+  * the SkipMonitor divergence watchdog, run ONE STEP DELAYED — the
+    guard counters for iteration ``i`` are fetched only after iteration
+    ``i + 1`` has been dispatched, so the async device pipeline never
+    stalls on the watchdog's host sync;
+  * periodic preemption-safe auto-checkpointing (every
+    ``checkpoint_every`` iterations), with the cumulative step count so
+    a resumed run keeps advancing past the loaded step;
+  * the simulated-preemption kill for checkpoint/resume drills
+    (``fault_profile`` ``preempt_at`` clause).
+
+One definition so the PPO and IMPALA loops cannot drift.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from gymfx_tpu.resilience.faults import SimulatedPreemptionError
+from gymfx_tpu.resilience.guards import (
+    NonFiniteDivergenceError,
+    SkipMonitor,
+)
+
+GUARD_METRIC_KEYS = ("nonfinite_skips", "guard_updates", "poisoned_env_resets")
+
+# state_dict_fn: () -> (full state dict to checkpoint, params tree)
+StateFn = Callable[[], Tuple[Dict[str, Any], Any]]
+
+
+class ResilientLoop:
+    """Call :meth:`after_step` once per train iteration and
+    :meth:`finish` after the loop; raises
+    :class:`~gymfx_tpu.resilience.guards.NonFiniteDivergenceError` on
+    sustained divergence (after saving a diagnostic checkpoint when a
+    checkpoint dir is configured) and
+    :class:`~gymfx_tpu.resilience.faults.SimulatedPreemptionError` at
+    the injected kill point (after the iteration's checkpoint, so the
+    drill resumes from it)."""
+
+    def __init__(
+        self,
+        *,
+        steps_per_iter: int,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 0,
+        step_offset: int = 0,
+        checkpoint_metadata: Optional[Dict[str, Any]] = None,
+        max_consecutive_skips: int = 10,
+        preempt_at: Optional[int] = None,
+    ):
+        self.steps_per_iter = int(steps_per_iter)
+        self.checkpoint_dir = str(checkpoint_dir) if checkpoint_dir else None
+        self.checkpoint_every = int(checkpoint_every or 0)
+        self.step_offset = int(step_offset or 0)
+        self.checkpoint_metadata = checkpoint_metadata
+        self.preempt_at = None if preempt_at is None else int(preempt_at)
+        self.monitor = (
+            SkipMonitor(max_consecutive_skips)
+            if int(max_consecutive_skips or 0) > 0
+            else None
+        )
+        self.last_checkpoint_step: Optional[int] = None
+        self._pending: Optional[Tuple[int, Dict[str, Any]]] = None
+
+    # ------------------------------------------------------------------
+    def _save(self, state_fn: StateFn, step: int) -> None:
+        from gymfx_tpu.train.checkpoint import save_checkpoint
+
+        state_dict, params = state_fn()
+        save_checkpoint(
+            self.checkpoint_dir, state_dict, step=step,
+            metadata=self.checkpoint_metadata, params=params,
+        )
+        self.last_checkpoint_step = step
+
+    def _check_pending(self, state_fn: StateFn) -> None:
+        if self.monitor is None or self._pending is None:
+            return
+        it, guard_metrics = self._pending
+        self._pending = None
+        try:
+            self.monitor.update(guard_metrics, step=it)
+        except NonFiniteDivergenceError:
+            # params are still the last finite values (the in-graph
+            # guard kept them) — persist them for the post-mortem/resume
+            if self.checkpoint_dir:
+                self._save(
+                    state_fn, self.step_offset + (it + 1) * self.steps_per_iter
+                )
+            raise
+
+    # ------------------------------------------------------------------
+    def after_step(self, it: int, metrics: Dict[str, Any],
+                   state_fn: StateFn) -> None:
+        if self.monitor is not None:
+            self._check_pending(state_fn)
+            self._pending = (
+                it,
+                {k: metrics[k] for k in GUARD_METRIC_KEYS if k in metrics},
+            )
+        if (
+            self.checkpoint_dir
+            and self.checkpoint_every > 0
+            and (it + 1) % self.checkpoint_every == 0
+        ):
+            self._save(
+                state_fn, self.step_offset + (it + 1) * self.steps_per_iter
+            )
+        if self.preempt_at is not None and it + 1 >= self.preempt_at:
+            raise SimulatedPreemptionError(it + 1)
+
+    def finish(self, state_fn: StateFn) -> None:
+        """Flush the one-step-delayed watchdog after the loop ends."""
+        self._check_pending(state_fn)

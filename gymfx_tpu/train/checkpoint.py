@@ -190,6 +190,34 @@ _MIGRATED_FIELDS = {
 }
 
 
+def _is_structure_mismatch(exc: BaseException) -> bool:
+    """Whether a template-validated restore failure looks like a tree-
+    STRUCTURE mismatch (rebuildable from a raw restore) rather than an
+    I/O / storage fault (never rebuildable — retrying with no template
+    would only mask the real error).
+
+    Orbax and flax wrap structure mismatches in their own exception
+    types (which vary across versions), so beyond the stdlib trio the
+    check is by module + message rather than by class identity."""
+    if isinstance(exc, OSError):
+        # includes FileNotFoundError — cold-start detection upstream
+        # (resume_from_config) depends on it propagating untouched
+        return False
+    if isinstance(exc, (ValueError, KeyError, TypeError)):
+        return True
+    module = type(exc).__module__ or ""
+    if module.split(".")[0] in ("orbax", "flax", "jax"):
+        msg = str(exc).lower()
+        return any(
+            marker in msg
+            for marker in (
+                "structure", "mismatch", "does not match", "not match",
+                "pytree", "missing field", "unexpected key", "custom node",
+            )
+        )
+    return False
+
+
 def _rebuild_like(template: Any, raw: Any, path: str = "") -> Any:
     """Rebuild ``raw`` (orbax's dict/list structure) into the template's
     NamedTuple/dict/tuple structure, synthesizing zero-leaves for fields
@@ -251,7 +279,9 @@ def load_train_state(directory: str, trainer: Any, state_cls: Any):
                 directory, template=template_nt._asdict()
             )
             return state_cls(**restored), None, step
-        except (ValueError, KeyError, TypeError):
+        except Exception as exc:
+            if not _is_structure_mismatch(exc):
+                raise
             # structure mismatch only: the stored tree may predate
             # newly-added EnvState fields (e.g. pending_forced, r4) —
             # raw-restore and rebuild with the documented backfills; a

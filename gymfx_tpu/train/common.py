@@ -161,7 +161,8 @@ def eval_checkpointed_policy(
     return summary
 
 
-def validate_minibatch_scheme(scheme: str, n_envs: int, minibatches: int) -> None:
+def validate_minibatch_scheme(scheme: str, n_envs: int, minibatches: int,
+                              *, horizon: Optional[int] = None) -> None:
     """Construction-time validation shared by the PPO trainers."""
     if scheme not in ("sample_permute", "env_permute"):
         raise ValueError(
@@ -173,6 +174,26 @@ def validate_minibatch_scheme(scheme: str, n_envs: int, minibatches: int) -> Non
             f"env_permute needs num_envs ({n_envs}) divisible by "
             f"ppo_minibatches ({minibatches})"
         )
+    if scheme == "sample_permute" and horizon is not None:
+        # minibatch_plan slices the permutation into minibatches chunks
+        # of floor(T*N / minibatches) — a non-zero remainder of samples
+        # is silently never trained on each epoch.  Mirror the
+        # env_permute divisibility check as a warning (the drop is a
+        # per-epoch random subset, so it biases coverage, not
+        # correctness).
+        total = int(horizon) * int(n_envs)
+        dropped = total % int(minibatches)
+        if dropped:
+            import warnings
+
+            warnings.warn(
+                f"sample_permute drops {dropped} of {total} samples per "
+                f"epoch (horizon*num_envs={total} not divisible by "
+                f"ppo_minibatches={minibatches}); pick sizes where "
+                "horizon*num_envs % minibatches == 0 to train on every "
+                "sample",
+                stacklevel=2,
+            )
 
 
 def minibatch_plan(fields, *, scheme: str, n_envs: int, horizon: int,

@@ -57,6 +57,10 @@ class ImpalaConfig(NamedTuple):
     policy: str = "lstm"
     policy_dtype: Any = jnp.float32
     policy_kwargs: Tuple[Tuple[str, Any], ...] = ()
+    # non-finite guard (resilience/guards.py): skip the whole learner
+    # update when loss/grads go non-finite and quarantine-reset envs
+    # whose segment produced NaN/inf (see train/ppo.py)
+    nonfinite_guard: bool = True
 
 
 def impala_config_from(config: Dict[str, Any]) -> ImpalaConfig:
@@ -80,6 +84,7 @@ def impala_config_from(config: Dict[str, Any]) -> ImpalaConfig:
             (k, tuple(v) if isinstance(v, list) else v)
             for k, v in (config.get("policy_kwargs") or {}).items()
         ),
+        nonfinite_guard=bool(config.get("nonfinite_guard", True)),
     )
 
 
@@ -309,10 +314,56 @@ class ImpalaTrainer:
         (loss, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
             state.learner_params, traj, state.policy_carry, obs_vec
         )
-        updates, opt_state = self.optimizer.update(
+        updates, new_opt_state = self.optimizer.update(
             grads, state.opt_state, state.learner_params
         )
-        learner_params = optax.apply_updates(state.learner_params, updates)
+        new_params = optax.apply_updates(state.learner_params, updates)
+
+        metrics = dict(
+            loss=loss,
+            mean_reward=traj["reward"].mean(),
+            mean_episode_done=traj["done"].mean(),
+            **aux,
+        )
+        if self.icfg.nonfinite_guard:
+            from gymfx_tpu.resilience.guards import (
+                quarantine_mask,
+                select_tree,
+                tree_all_finite,
+            )
+
+            # IMPALA takes ONE update per step, so the guard is
+            # whole-step: a non-finite loss/grad keeps last-good
+            # learner params and opt-state bit-for-bit
+            ok = jnp.isfinite(loss) & tree_all_finite(grads)
+            learner_params = select_tree(
+                ok, new_params, state.learner_params
+            )
+            opt_state = select_tree(ok, new_opt_state, state.opt_state)
+            metrics["nonfinite_skips"] = 1.0 - ok.astype(jnp.float32)
+            metrics["guard_updates"] = jnp.asarray(1.0, jnp.float32)
+            # quarantine envs whose segment or carried state went
+            # non-finite (sticky NaN equity, see train/ppo.py)
+            poison = quarantine_mask(
+                {
+                    "reward": traj["reward"],
+                    "obs": traj["obs"],
+                    "mu_logp": traj["mu_logp"],
+                },
+                env_axis=1,
+            ) | quarantine_mask(
+                # NaN-only for carried state: env peak/min/max trackers
+                # hold ±inf sentinels by design (core/types.py)
+                {"obs_vec": obs_vec, "env_states": env_states},
+                env_axis=0, mode="nan",
+            )
+            carry0 = self.policy.initial_carry(())
+            env_states = masked_reset(poison, self._reset_state, env_states)
+            obs_vec = masked_reset(poison, self._reset_vec, obs_vec)
+            pcarry = masked_reset(poison, carry0, pcarry)
+            metrics["poisoned_env_resets"] = poison.astype(jnp.float32).sum()
+        else:
+            learner_params, opt_state = new_params, new_opt_state
 
         count = state.updates_since_sync + 1
         do_sync = count >= self.icfg.sync_every
@@ -323,12 +374,6 @@ class ImpalaTrainer:
         )
         count = jnp.where(do_sync, 0, count)
 
-        metrics = dict(
-            loss=loss,
-            mean_reward=traj["reward"].mean(),
-            mean_episode_done=traj["done"].mean(),
-            **aux,
-        )
         return (
             ImpalaState(
                 learner_params, actor_params, opt_state, env_states,
@@ -343,7 +388,12 @@ class ImpalaTrainer:
 
     def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0,
               initial_state: Optional[ImpalaState] = None,
-              initial_params=None):
+              initial_params=None,
+              *, checkpoint_dir: Optional[str] = None,
+              checkpoint_every: int = 0, step_offset: int = 0,
+              checkpoint_metadata: Optional[Dict[str, Any]] = None,
+              max_consecutive_skips: int = 10,
+              preempt_at: Optional[int] = None):
         if initial_state is not None:
             state = initial_state
             if self.mesh is not None:
@@ -361,19 +411,38 @@ class ImpalaTrainer:
                 state = self._shard_state(state)
         per_iter = self.icfg.n_envs * self.icfg.unroll
         iters = max(1, int(total_env_steps) // per_iter)
+        from gymfx_tpu.resilience.loop import ResilientLoop
+
+        hooks = ResilientLoop(
+            steps_per_iter=per_iter,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            step_offset=step_offset,
+            checkpoint_metadata=checkpoint_metadata,
+            max_consecutive_skips=(
+                max_consecutive_skips if self.icfg.nonfinite_guard else 0
+            ),
+            preempt_at=preempt_at,
+        )
         t0 = time.perf_counter()
         metrics: Dict[str, Any] = {}
         for it in range(iters):
             state, metrics = self.train_step(state)
+            hooks.after_step(
+                it, metrics, lambda: (state._asdict(), state.learner_params)
+            )
             if log_every and (it + 1) % log_every == 0:
                 print(f"[impala] iter {it + 1}/{iters} "
                       f"{ {k: float(v) for k, v in metrics.items()} }")
+        hooks.finish(lambda: (state._asdict(), state.learner_params))
         jax.block_until_ready(state.learner_params)
         dt = time.perf_counter() - t0
         out = {k: float(v) for k, v in metrics.items()}
         out["env_steps_per_sec"] = per_iter * iters / dt
         out["iterations"] = iters
         out["total_env_steps"] = per_iter * iters
+        if hooks.last_checkpoint_step is not None:
+            out["last_checkpoint_step"] = hooks.last_checkpoint_step
         return state, out
 
 
@@ -381,6 +450,16 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.train.common import build_train_eval_envs
 
     env, eval_env = build_train_eval_envs(config)
+    # chaos runs: contaminate the TRAINING feed per the fault_profile
+    # knob before the trainer closes over it (train/ppo.py)
+    from gymfx_tpu.resilience.faults import (
+        apply_fault_profile_to_market_data,
+        parse_fault_profile,
+    )
+
+    profile = parse_fault_profile(config.get("fault_profile"))
+    if profile["nan_bars"] or profile["inf_bars"]:
+        env.data = apply_fault_profile_to_market_data(env.data, profile)
     icfg = impala_config_from(config)
     from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
 
@@ -396,6 +475,15 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     state, train_metrics = trainer.train(
         total, seed=int(config.get("seed", 0) or 0),
         initial_state=resume_state, initial_params=resume_params,
+        checkpoint_dir=config.get("checkpoint_dir"),
+        checkpoint_every=int(config.get("checkpoint_every", 0) or 0),
+        step_offset=resume_step,
+        checkpoint_metadata={"policy": icfg.policy,
+                             "policy_kwargs": dict(icfg.policy_kwargs)},
+        max_consecutive_skips=int(
+            config.get("guard_max_consecutive_skips", 10) or 0
+        ),
+        preempt_at=profile.get("preempt_at"),
     )
 
     # greedy eval through the shared evaluate() machinery
@@ -417,13 +505,16 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     if ckpt_dir:
         from gymfx_tpu.train.checkpoint import save_checkpoint
 
-        save_checkpoint(
-            ckpt_dir, state._asdict(),
-            step=resume_step + train_metrics["total_env_steps"],
-            metadata={"policy": icfg.policy,
-                      "policy_kwargs": dict(icfg.policy_kwargs)},
-            params=state.learner_params,
-        )
+        # skip when the periodic auto-checkpoint already landed here
+        final_step = resume_step + train_metrics["total_env_steps"]
+        if train_metrics.get("last_checkpoint_step") != final_step:
+            save_checkpoint(
+                ckpt_dir, state._asdict(),
+                step=final_step,
+                metadata={"policy": icfg.policy,
+                          "policy_kwargs": dict(icfg.policy_kwargs)},
+                params=state.learner_params,
+            )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary
 

@@ -163,7 +163,8 @@ class PortfolioPPOTrainer:
         from gymfx_tpu.train.common import validate_minibatch_scheme
 
         validate_minibatch_scheme(
-            pcfg.minibatch_scheme, pcfg.n_envs, pcfg.minibatches
+            pcfg.minibatch_scheme, pcfg.n_envs, pcfg.minibatches,
+            horizon=pcfg.horizon,
         )
         n_pairs = env.cfg.n_pairs
         if pcfg.policy == "transformer":

@@ -132,7 +132,8 @@ def test_export_scaled_features_via_kernel_matches_obs_semantics(tmp_path):
     """--export_scaled_features materializes the episode's scaled
     feature windows through the pallas kernel's product path (VERDICT
     r4 weak #4): values must equal the reference implementation, with
-    binary columns passed through raw like the obs path."""
+    binary columns passed through like the obs path — raw values, but
+    still under the obs clamp (feature_clip + nan_to_num, ADVICE r5)."""
     out = tmp_path / "features.npz"
     summary, _ = _run(
         tmp_path, SAMPLE, "--driver_mode", "flat",
@@ -167,9 +168,16 @@ def test_export_scaled_features_via_kernel_matches_obs_semantics(tmp_path):
         clip=float(env.cfg.feature_clip or 0.0),
     ))
     raw = np.asarray(env.data.padded_features)
+    clip = float(env.cfg.feature_clip or 0.0)
     np.testing.assert_allclose(arr[:, :, 0], ref[:, :, 0], atol=1e-5)
-    for i, s in enumerate(range(1, 121)):        # binary col: raw values
-        np.testing.assert_allclose(arr[i, :, 1], raw[s:s + 8, 1], atol=1e-6)
+    for i, s in enumerate(range(1, 121)):
+        # binary col: raw values through the obs clamp (build_obs clips
+        # the whole window AFTER the passthrough substitution)
+        want = np.nan_to_num(
+            np.clip(raw[s:s + 8, 1], -clip, clip),
+            nan=0.0, posinf=clip, neginf=-clip,
+        )
+        np.testing.assert_allclose(arr[i, :, 1], want, atol=1e-6)
 
 
 def test_export_scaled_features_requires_feature_columns(tmp_path):

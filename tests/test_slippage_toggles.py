@@ -222,3 +222,32 @@ def test_crosscheck_accepts_non_default_switches():
     )
     result = crosscheck_episode(dict(env.config), actions=[1, 0, 0], env=env)
     assert result["within_bound"], result
+
+
+def test_snap_in_bar_degenerate_bar_narrower_than_one_tick():
+    """A bar narrower than one venue tick with off-grid H/L (a
+    data/venue inconsistency) has NO on-grid in-bar price.  snap_in_bar
+    must keep the nearest tick instead of oscillating: the one-tick
+    corrections only fire when they LAND in-bar (core/broker.py)."""
+    import jax.numpy as jnp
+
+    from gymfx_tpu.core.broker import snap_in_bar
+
+    tick = 0.001
+    # bar [1.0004, 1.0006] straddles the tick midpoint: nearest tick to
+    # anything clipped into the bar is 1.000 or 1.001, both OUT of bar,
+    # and neither one-tick correction lands in-bar either
+    low, high = 1.0004, 1.0006
+    for price in (0.9, 1.0005, 1.1):
+        q = float(snap_in_bar(jnp.float32(price), low, high, tick))
+        # result is the nearest on-grid price to the clipped input —
+        # within half a tick of the bar, never NaN, never off-grid
+        assert np.isfinite(q)
+        assert abs(round(q / tick) * tick - q) < 1e-6      # on-grid
+        assert low - tick <= q <= high + tick              # near the bar
+    # zero-width degenerate bar on-grid: identity
+    q = float(snap_in_bar(jnp.float32(1.002), 1.002, 1.002, tick))
+    assert q == pytest.approx(1.002, abs=1e-6)
+    # tick == 0 disables quantization entirely: pure clip
+    q = float(snap_in_bar(jnp.float32(1.0005), low, high, 0.0))
+    assert q == pytest.approx(1.0005, abs=1e-7)
