@@ -63,6 +63,11 @@ def main() -> None:
     # default 20 per bench_util.DEFAULT_BENCH_ITERS (dispatch-latency
     # amortization — the round-3 "headline regression" was 5-iter noise)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument(
+        "--supersteps", type=int, default=1,
+        help="train steps fused per dispatch (superstep driver; 1 = "
+             "per-step dispatch)",
+    )
     ap.add_argument("--quick", action="store_true", help="small shapes (CI)")
     args = ap.parse_args()
     if args.quick:
@@ -98,15 +103,32 @@ def main() -> None:
     env = Environment(config)
     trainer = PPOTrainer(env, ppo_config_from(config))
 
-    from gymfx_tpu.bench_util import measure_train_step, mfu
+    from gymfx_tpu.bench_util import measure_train_many, measure_train_step, mfu
 
     state = trainer.init_state(0)
-    dt, step_flops, state, _step = measure_train_step(trainer, state, args.iters)
+    # always time the per-step dispatch path: it is both the K=1
+    # headline and the baseline the superstep overhead is measured from
+    dt1, step_flops, state, _step = measure_train_step(trainer, state, args.iters)
+    per_step_single = dt1 / args.iters
 
-    env_steps = args.n_envs * args.horizon * args.iters
-    steps_per_sec = env_steps / dt
+    K = max(1, args.supersteps)
     baseline_per_chip = 1_000_000 / 8  # BASELINE.json: 1M steps/s on v5p-8
-    util = mfu(step_flops, args.iters, dt, jax.devices()[0])
+    steps_per_iter = args.n_envs * args.horizon
+    if K > 1:
+        # same number of timed dispatches, each covering K train steps
+        dtK, dispatch_flops, state, _ = measure_train_many(
+            trainer, state, args.iters, K
+        )
+        per_step = dtK / (args.iters * K)
+        steps_per_sec = steps_per_iter / per_step
+        util = mfu(dispatch_flops, args.iters, dtK, jax.devices()[0])
+        # fraction of per-step wall time that was host dispatch/sync
+        # overhead, eliminated by fusing K steps into one dispatch
+        overhead = max(0.0, 1.0 - per_step / per_step_single)
+    else:
+        steps_per_sec = steps_per_iter / per_step_single
+        util = mfu(step_flops, args.iters, dt1, jax.devices()[0])
+        overhead = None
     print(
         json.dumps(
             {
@@ -118,6 +140,14 @@ def main() -> None:
                 # XLA cost-model FLOPs / public peak bf16 chip FLOPs
                 # (gymfx_tpu/bench_util.py); null off-TPU
                 "mfu": round(util, 5) if util is not None else None,
+                "supersteps": K,
+                # per-train-step host overhead removed by the superstep
+                # driver: 1 - (superstep per-step time / single-dispatch
+                # per-step time); null at K=1 (nothing to compare)
+                "dispatch_overhead_frac": (
+                    round(overhead, 4) if overhead is not None else None
+                ),
+                "per_step_ms_single_dispatch": round(per_step_single * 1e3, 3),
             }
         )
     )

@@ -199,7 +199,11 @@ def _export_scaled_features(env, config, n_steps: int, path: str):
 
     from gymfx_tpu.ops.window_zscore import batched_scaled_windows
 
-    cfg, data = env.cfg, env.data
+    cfg = env.cfg
+    data = (
+        env.require_resident_data("export_scaled_features")
+        if hasattr(env, "require_resident_data") else env.data
+    )
     if cfg.n_features == 0:
         raise ValueError(
             "export_scaled_features requires feature_columns in the config "
@@ -257,6 +261,8 @@ def _run_env_scan(config: Dict[str, Any]) -> Dict[str, Any]:
     n_envs = int(config.get("num_envs", 1) or 1)
     batch_stats = None
     if n_envs > 1:
+        if env.streaming:
+            env.require_resident_data("num_envs > 1 batch evaluation")
         # batch evaluation (new capability): vmap the whole episode over
         # per-env rng streams and aggregate outcome statistics; the
         # detailed summary below reports env 0's episode

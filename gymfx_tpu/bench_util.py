@@ -47,6 +47,28 @@ def measure_train_step(trainer: Any, state: Any, iters: int):
     jax.block_until_ready(state)
     return time.perf_counter() - t0, flops, state, step
 
+def measure_train_many(trainer: Any, state: Any, dispatches: int, k: int):
+    """Superstep twin of :func:`measure_train_step`: times ``dispatches``
+    invocations of the compiled K-step ``train_many`` program (one
+    donated lax.scan dispatch per K train steps).  Returns ``(seconds,
+    flops_per_dispatch, final_state, step)`` — divide seconds by
+    ``dispatches * k`` for per-train-step time."""
+    import jax
+
+    compiled, flops = compile_with_flops(trainer._train_many, state, k)
+    if compiled is not None:
+        step = compiled  # static k is baked into the executable
+    else:
+        step = lambda s: trainer.train_many(s, k)  # noqa: E731
+    state, _ = step(state)  # warmup
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        state, _metrics = step(state)
+    jax.block_until_ready(state)
+    return time.perf_counter() - t0, flops, state, step
+
+
 # Public per-chip peak dense bf16 FLOPs/sec (vendor-published specs).
 PEAK_BF16_FLOPS = {
     "v6e": 918e12,

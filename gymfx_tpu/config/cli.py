@@ -56,4 +56,8 @@ def parse_args(argv=None):
     parser.add_argument("--fault_profile", type=str)
     parser.add_argument("--guard_max_consecutive_skips", type=int)
 
+    # dispatch / memory flags (docs/performance.md)
+    parser.add_argument("--supersteps_per_dispatch", type=int)
+    parser.add_argument("--stream_hbm_budget_mb", type=float)
+
     return parser.parse_known_args(argv)

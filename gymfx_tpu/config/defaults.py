@@ -90,6 +90,16 @@ DEFAULT_VALUES = {
     # deterministic fault-injection profile for chaos tests, e.g.
     # "nan_bars=30-31;transport=http:503,http:503,ok;preempt_at=2;seed=7"
     "fault_profile": None,
+
+    # ---- dispatch / memory (docs/performance.md) ----
+    # superstep driver: fuse K train steps into one donated lax.scan
+    # dispatch; metrics (incl. guard counters) accumulate on device and
+    # are fetched once per superstep (1 = per-step dispatch)
+    "supersteps_per_dispatch": 1,
+    # stream the bar history host->device in double-buffered shards when
+    # the resident MarketData would exceed this many MiB (None = always
+    # resident); rollout-only — trainers need the full history resident
+    "stream_hbm_budget_mb": None,
     # live-path retry/backoff + circuit breaker (oanda_broker plugin)
     "live_retry_max_attempts": 4,
     "live_retry_base_delay": 0.25,

@@ -78,17 +78,21 @@ def reset_at(
     step, so the streaming-window fast path is unaffected.
     """
     t0 = jnp.asarray(t0, jnp.int32)
+    # every data read is rebased by row0: a streamed shard carries its
+    # global start row there (0 when fully resident), so cursors stay
+    # global while array indices are shard-local
+    r0 = data.row0
     state = initial_state(cfg)
     state = state._replace(t=t0)
-    state = broker.mark_to_market(state, data.close[t0], params)
+    state = broker.mark_to_market(state, data.close[t0 - r0], params)
     state = state._replace(
         prev_equity_delta=state.equity_delta,
         price_window=jax.lax.dynamic_slice(
-            data.padded_close, (t0 + 1,), (cfg.window_size,)
+            data.padded_close, (t0 + 1 - r0,), (cfg.window_size,)
         ).astype(state.price_window.dtype),
         feat_window=jax.lax.dynamic_slice(
             data.padded_features,
-            (t0 + 1, jnp.zeros((), jnp.int32)),
+            (t0 + 1 - r0, jnp.zeros((), jnp.int32)),
             (cfg.window_size, cfg.n_features),
         ),
     )
@@ -133,11 +137,12 @@ def step(
     act_strategy = live & ~exhausted          # warmup or advancing step
 
     t_new = jnp.where(advance, state.t + 1, state.t)
-    o = data.open[t_new]
-    h = data.high[t_new]
-    l = data.low[t_new]
-    c = data.close[t_new]
-    mow = data.minute_of_week[t_new]
+    r0 = data.row0  # shard-local rebase (0 when fully resident)
+    o = data.open[t_new - r0]
+    h = data.high[t_new - r0]
+    l = data.low[t_new - r0]
+    c = data.close[t_new - r0]
+    mow = data.minute_of_week[t_new - r0]
 
     st = state._replace(t=t_new, last_trade_cost=jnp.zeros_like(state.last_trade_cost))
 
@@ -156,7 +161,7 @@ def step(
     #     FXRolloverInterestModule (reference
     #     simulation_engines/nautilus_gym.py:276-290).
     if cfg.financing_enabled:
-        accrual = st.pos * c * data.rollover_accrual[t_new]
+        accrual = st.pos * c * data.rollover_accrual[t_new - r0]
         st = st._replace(
             cash_delta=st.cash_delta + jnp.where(advance, accrual, 0.0)
         )
@@ -225,7 +230,7 @@ def step(
             price_window=jnp.where(advance, new_price, st.price_window)
         )
     if cfg.n_features > 0:
-        new_feat_row = data.padded_features[t_new + cfg.window_size]
+        new_feat_row = data.padded_features[t_new + cfg.window_size - r0]
         new_feat = jnp.concatenate([st.feat_window[1:], new_feat_row[None, :]])
         st = st._replace(
             feat_window=jnp.where(advance, new_feat, st.feat_window)
@@ -237,7 +242,7 @@ def step(
     st, base_reward = rewards.compute_reward(st, cfg, params, live)
     fc_row = jnp.minimum(st.t + 1, n - 1)
     penalty = rewards.force_close_penalty(
-        st, data.force_close[fc_row], cfg, params
+        st, data.force_close[fc_row - r0], cfg, params
     )
     penalty = jnp.where(live, penalty, 0.0)
     reward = base_reward - penalty
@@ -302,7 +307,7 @@ def _event_overlay(state, a, data: MarketData, cfg: EnvConfig, params: EnvParams
     Reads engineered no-trade columns at the upcoming row and blocks new
     entries / force-flattens open positions during event windows."""
     n = cfg.n_bars
-    row = jnp.minimum(jnp.minimum(state.t + 1, n), n - 1)
+    row = jnp.minimum(jnp.minimum(state.t + 1, n), n - 1) - data.row0
     no_trade_value = data.ev_no_trade[row]
     spread_mult = data.ev_spread_mult[row]
     slip_mult = data.ev_slip_mult[row]

@@ -46,13 +46,14 @@ def build_obs(
     w = cfg.window_size
     n = cfg.n_bars
     step = jnp.minimum(state.t + 1, n)  # == bar_index, clamped
+    r0 = data.row0  # shard-local rebase for streamed data (0 resident)
     obs: Dict[str, Any] = {}
 
     if cfg.n_features > 0:
         win = state.feat_window  # streaming carry == padded[step : step+w]
-        mean = data.feat_mean[step]
-        std = data.feat_std[step]
-        neutral = data.feat_neutral[step]
+        mean = data.feat_mean[step - r0]
+        std = data.feat_std[step - r0]
+        neutral = data.feat_neutral[step - r0]
         scaled = jnp.where(neutral, 0.0, (win - mean) / std)
         if any(cfg.binary_mask):
             mask = jnp.asarray(cfg.binary_mask, dtype=bool)
@@ -65,7 +66,7 @@ def build_obs(
         )
         obs["features"] = scaled.astype(jnp.float32)
 
-    price = data.close[state.t]
+    price = data.close[state.t - r0]
     prices = None
     if cfg.include_prices:
         prices = state.price_window  # streaming carry
@@ -88,7 +89,7 @@ def build_obs(
         remaining = jnp.maximum(0, n - (state.t + 1)) / max(1, n)
         obs["steps_remaining_norm"] = jnp.asarray([remaining], dtype=jnp.float32)
 
-    row = jnp.minimum(step, n - 1)
+    row = jnp.minimum(step, n - 1) - r0
     if cfg.stage_b_force_close_obs:
         fc = data.force_close[row]
         for i, key in enumerate(FORCE_CLOSE_FEATURE_KEYS):
@@ -131,10 +132,11 @@ def build_info(
     event_info: Dict[str, Any] | None = None,
 ) -> Dict[str, Any]:
     n = cfg.n_bars
+    r0 = data.row0  # shard-local rebase for streamed data (0 resident)
     info: Dict[str, Any] = {
         "equity": params.initial_cash + state.equity_delta,
         "position": jnp.sign(state.pos).astype(jnp.int32),
-        "price": data.close[state.t],
+        "price": data.close[state.t - r0],
         "bar_index": state.t + 1,
         "total_bars": jnp.asarray(n, dtype=jnp.int32),
         "trades": state.trade_count,
@@ -152,7 +154,7 @@ def build_info(
     if event_info:
         info.update(event_info)
 
-    row = jnp.minimum(jnp.minimum(state.t + 1, n), n - 1)
+    row = jnp.minimum(jnp.minimum(state.t + 1, n), n - 1) - r0
     if cfg.stage_b_force_close_obs:
         fc = data.force_close[row]
         for i, key in enumerate(FORCE_CLOSE_FEATURE_KEYS):
@@ -165,7 +167,7 @@ def build_info(
         from gymfx_tpu.core import broker as _broker
 
         info["margin_closeout_percent"] = _broker.margin_closeout_percent(
-            state, data.close[state.t], params, cfg.margin_model
+            state, data.close[state.t - r0], params, cfg.margin_model
         ).astype(jnp.float32)
         info["margin_available_norm"] = (
             params.initial_cash + state.equity_delta

@@ -244,3 +244,63 @@ def rollout_chunked(
     else:
         outputs = {}
     return state, outputs
+
+
+def rollout_streamed(
+    cfg: EnvConfig,
+    params: EnvParams,
+    streamer,
+    driver: Driver,
+    steps: int,
+    rng: Any,
+    collect: bool = True,
+    driver_carry: Any = None,
+    chunk_size: int = 64,
+):
+    """Episode rollout over a :class:`~gymfx_tpu.data.feed.BarStreamer`.
+
+    Behaviorally identical to ``rollout_chunked`` on the fully-resident
+    dataset — same scan body, same cursor sequence; each shard's
+    ``row0`` rebases the global bar cursor into shard-local array
+    indices — but only two shards ever occupy device memory, and the
+    streamer enqueues shard ``t+1``'s host→device transfer before the
+    chunks of shard ``t`` are dispatched, so the DMA overlaps compute.
+
+    Every shard has identical static shapes, so all shards share the
+    same compiled chunk executable(s).
+
+    Caveat: an episode that terminates mid-stream freezes its cursor at
+    the terminal bar; once serving moves to a shard that no longer
+    covers the frozen cursor, the (inert, post-``done``) obs/info reads
+    clamp to the shard edge and may differ from the resident path.
+    Steps at or before termination are bit-identical.
+    """
+    state = obs = None
+    dcarry = driver.init() if driver_carry is None else driver_carry
+    pieces = []
+    done_steps = 0
+    for lo, hi, shard in streamer.iter_shards():
+        if state is None:
+            # cursor starts at bar 0 — shard 0 always covers it
+            state, obs = env_core.reset(cfg, params, shard)
+            if steps <= 0:
+                return state, {}
+        # step i advances the cursor to bar i (i=0 is the warmup step at
+        # bar 0): shard serving cursors [lo, hi) runs steps [lo, hi)
+        end = steps if hi is None else min(int(hi), steps)
+        while done_steps < end:
+            this = min(chunk_size, end - done_steps)
+            state, obs, rng, dcarry, out = _rollout_chunk(
+                cfg, params, shard, driver, this, state, obs, rng, dcarry,
+                jnp.asarray(done_steps, jnp.int32), collect,
+            )
+            if collect:
+                pieces.append(out)
+            done_steps += this
+        if done_steps >= steps:
+            break
+    if collect and pieces:
+        outputs = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *pieces)
+    else:
+        outputs = {}
+    return state, outputs

@@ -128,7 +128,11 @@ class Environment:
             self.config, self.cfg.financing_enabled
         )
 
-        self.data: MarketData = self.dataset.build_market_data(
+        budget = config.get("stream_hbm_budget_mb")
+        self.stream_budget_mb: Optional[float] = (
+            float(budget) if budget else None
+        )
+        md_kwargs = dict(
             window_size=self.cfg.window_size,
             feature_columns=feature_columns,
             feature_scaling=str(config.get("feature_scaling", "rolling_zscore")),
@@ -151,24 +155,80 @@ class Environment:
             instrument=str(config.get("instrument", "EUR_USD")),
         )
 
+        self.streamer = None
+        self.host_data: Optional[MarketData] = None
+        if self.stream_budget_mb is not None:
+            from gymfx_tpu.data.feed import BarStreamer, market_data_nbytes
+
+            host = self.dataset.build_market_data(device=False, **md_kwargs)
+            if market_data_nbytes(host) > self.stream_budget_mb * 2**20:
+                # streamed: shards are uploaded on demand (rollout path);
+                # no resident device copy exists
+                self.host_data = host
+                self.streamer = BarStreamer(
+                    host,
+                    window_size=self.cfg.window_size,
+                    budget_mb=self.stream_budget_mb,
+                )
+                self.data = None
+            else:
+                # fits the budget after all — resident, bit-identical to
+                # the default path (same host-side casts, one device_put)
+                self.data = jax.tree.map(jax.device_put, host)
+        else:
+            self.data: MarketData = self.dataset.build_market_data(**md_kwargs)
+
     # ------------------------------------------------------------------
     @property
     def n_bars(self) -> int:
         return self.cfg.n_bars
 
+    @property
+    def streaming(self) -> bool:
+        return self.streamer is not None
+
+    def require_resident_data(self, what: str) -> MarketData:
+        """The resident device MarketData, or a loud error for paths
+        that need random access to the whole history (trainers, batch
+        scans, gym stepping) while the dataset is being streamed."""
+        if self.data is None:
+            raise ValueError(
+                f"{what} requires the full bar history resident in "
+                "device memory, but this Environment streams it in "
+                f"shards (stream_hbm_budget_mb={self.stream_budget_mb}); "
+                "unset stream_hbm_budget_mb or raise the budget"
+            )
+        return self.data
+
     def reset(self, params: Optional[EnvParams] = None):
-        return env_core.jit_reset(self.cfg, params or self.params, self.data)
+        return env_core.jit_reset(
+            self.cfg, params or self.params, self.require_resident_data("reset()")
+        )
 
     def step(self, state: EnvState, action, params: Optional[EnvParams] = None):
         return env_core.jit_step(
-            self.cfg, params or self.params, self.data, state, action
+            self.cfg, params or self.params,
+            self.require_resident_data("step()"), state, action
         )
 
     def rollout(self, driver, steps: int, seed: int = 0, params=None,
                 collect=True, chunk_size: int = 64):
         """Host-level episode rollout (chunked: compile cost independent
         of episode length).  For rollouts INSIDE jit/vmap use
-        core.rollout.rollout directly."""
+        core.rollout.rollout directly.  On a streaming Environment the
+        shards are uploaded double-buffered while the episode runs
+        (rollout_streamed)."""
+        if self.streamer is not None:
+            return rollout_mod.rollout_streamed(
+                self.cfg,
+                params or self.params,
+                self.streamer,
+                driver,
+                int(steps),
+                jax.random.PRNGKey(seed),
+                collect=collect,
+                chunk_size=chunk_size,
+            )
         return rollout_mod.rollout_chunked(
             self.cfg,
             params or self.params,

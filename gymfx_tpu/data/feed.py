@@ -52,6 +52,12 @@ class MarketData(NamedTuple):
     feat_mean: Any     # (n + 1, F) float32 — scaler mean fit on strictly-past rows
     feat_std: Any      # (n + 1, F) float32
     feat_neutral: Any  # (n + 1,) bool — True => neutral zero warm-up window
+    # global bar row of local array index 0.  Always 0 for a fully
+    # resident dataset; a streamed shard (shard_market_data) carries the
+    # shard's start row here so the env kernel can keep GLOBAL bar
+    # cursors (state.t) and rebase every array read by -row0 — one
+    # compiled program serves every shard.
+    row0: Any = 0
 
     @property
     def n_bars(self) -> int:
@@ -139,6 +145,7 @@ class MarketDataset:
         monday_entry_window_hours: int = 4,
         financing_rate_data: Any = None,
         instrument: str = "EUR_USD",
+        device: bool = True,
     ) -> MarketData:
         df = self.dataframe
         n = len(df)
@@ -200,25 +207,36 @@ class MarketDataset:
 
         import jax.numpy as jnp
 
+        # device=False keeps every array on the host (numpy, same final
+        # dtypes) so streaming callers can slice shards cheaply and
+        # device_put them on their own schedule (BarStreamer).
+        if device:
+            def A(x, dt):
+                return jnp.asarray(x, dtype=dt)
+        else:
+            def A(x, dt):
+                return np.asarray(x, dtype=dt)
+
         f32 = np.float32
         return MarketData(
-            open=jnp.asarray(o, dtype=dtype),
-            high=jnp.asarray(h, dtype=dtype),
-            low=jnp.asarray(l, dtype=dtype),
-            close=jnp.asarray(c, dtype=dtype),
-            volume=jnp.asarray(v, dtype=dtype),
-            padded_close=jnp.asarray(padded_close, dtype=dtype),
-            minute_of_week=jnp.asarray(mow, dtype=jnp.int32),
-            calendar=jnp.asarray(cal, dtype=f32),
-            force_close=jnp.asarray(fcz, dtype=f32),
-            ev_no_trade=jnp.asarray(ev_no_trade, dtype=f32),
-            ev_spread_mult=jnp.asarray(ev_spread, dtype=f32),
-            ev_slip_mult=jnp.asarray(ev_slip, dtype=f32),
-            rollover_accrual=jnp.asarray(accrual, dtype=dtype),
-            padded_features=jnp.asarray(padded_features, dtype=f32),
-            feat_mean=jnp.asarray(feat_mean, dtype=f32),
-            feat_std=jnp.asarray(feat_std, dtype=f32),
-            feat_neutral=jnp.asarray(feat_neutral, dtype=bool),
+            open=A(o, dtype),
+            high=A(h, dtype),
+            low=A(l, dtype),
+            close=A(c, dtype),
+            volume=A(v, dtype),
+            padded_close=A(padded_close, dtype),
+            minute_of_week=A(mow, np.int32),
+            calendar=A(cal, f32),
+            force_close=A(fcz, f32),
+            ev_no_trade=A(ev_no_trade, f32),
+            ev_spread_mult=A(ev_spread, f32),
+            ev_slip_mult=A(ev_slip, f32),
+            rollover_accrual=A(accrual, dtype),
+            padded_features=A(padded_features, f32),
+            feat_mean=A(feat_mean, f32),
+            feat_std=A(feat_std, f32),
+            feat_neutral=A(feat_neutral, bool),
+            row0=np.int32(0),
         )
 
 
@@ -291,6 +309,137 @@ def _build_feature_tensors(
         std.astype(np.float32),
         neutral,
     )
+
+
+def market_data_nbytes(data: MarketData) -> int:
+    """Total array bytes of a MarketData pytree (host or device)."""
+    total = 0
+    for leaf in data:
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def shard_market_data(data: MarketData, start: int, shard_bars: int,
+                      window_size: int) -> MarketData:
+    """Slice one streaming shard out of a (host) MarketData.
+
+    A shard anchored at global row ``start`` serves env steps whose bar
+    cursor lands in ``[start, start + shard_bars)``; a step at cursor
+    ``t`` also reads row ``t + 1`` (next-bar fills, event overlay), so
+    the bar arrays carry one row of lookahead and the front-padded
+    window sources carry ``window_size`` extra rows.  ``row0 = start``
+    lets the env kernel keep its GLOBAL cursor and rebase each read —
+    every shard has identical shapes, so one compiled program serves
+    them all.
+    """
+    hi = start + int(shard_bars) + 1
+    if hi > int(np.asarray(data.close).shape[0]):
+        raise ValueError(
+            f"shard [{start}, {hi}) exceeds dataset of "
+            f"{np.asarray(data.close).shape[0]} bars"
+        )
+    bar = slice(start, hi)
+    padded = slice(start, hi + int(window_size))
+    # scaler moments are (n + 1)-row tables indexed at min(t + 1, n):
+    # one more row of lookahead than the bar arrays
+    feat = slice(start, hi + 1)
+    return data._replace(
+        open=data.open[bar],
+        high=data.high[bar],
+        low=data.low[bar],
+        close=data.close[bar],
+        volume=data.volume[bar],
+        padded_close=data.padded_close[padded],
+        minute_of_week=data.minute_of_week[bar],
+        calendar=data.calendar[bar],
+        force_close=data.force_close[bar],
+        ev_no_trade=data.ev_no_trade[bar],
+        ev_spread_mult=data.ev_spread_mult[bar],
+        ev_slip_mult=data.ev_slip_mult[bar],
+        rollover_accrual=data.rollover_accrual[bar],
+        padded_features=data.padded_features[padded],
+        feat_mean=data.feat_mean[feat],
+        feat_std=data.feat_std[feat],
+        feat_neutral=data.feat_neutral[feat],
+        row0=np.int32(start),
+    )
+
+
+class BarStreamer:
+    """Double-buffered host→device streaming of a long bar history.
+
+    When the resident dataset would blow the HBM budget, the bar history
+    is cut into fixed-size shards (identical static shapes — every shard
+    reuses ONE compiled rollout executable) and each shard's
+    ``jax.device_put`` is issued BEFORE compute is dispatched on the
+    previous one, so the host→device DMA of shard ``t+1`` overlaps the
+    device compute on shard ``t``.  At most two shards are resident at
+    any time, which is why each shard targets half the budget.
+    """
+
+    def __init__(self, host_data: MarketData, *, window_size: int,
+                 budget_mb: float, min_shard_bars: int = 64):
+        self.host_data = host_data
+        self.window_size = int(window_size)
+        n = int(np.asarray(host_data.close).shape[0])
+        total = market_data_nbytes(host_data)
+        per_bar = max(1.0, total / max(1, n))
+        budget_bytes = float(budget_mb) * 2**20
+        shard_bars = int(budget_bytes / 2.0 / per_bar) - self.window_size - 1
+        shard_bars = max(int(min_shard_bars), shard_bars)
+        if shard_bars >= n - 1:
+            raise ValueError(
+                f"dataset ({n} bars, {total / 2**20:.1f} MiB) fits the "
+                f"{budget_mb} MiB streaming budget — streaming is not "
+                "needed; unset stream_hbm_budget_mb"
+            )
+        self.n_bars = n
+        self.shard_bars = shard_bars
+        # regular starts every shard_bars; the final shard is anchored so
+        # its lookahead row is the last bar — it overlaps the previous
+        # shard, keeping every shard the same static shape.
+        starts = list(range(0, n - shard_bars - 1, shard_bars))
+        last = n - shard_bars - 1
+        if not starts or starts[-1] != last:
+            starts.append(last)
+        self.starts = starts
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.starts)
+
+    def serve_ranges(self):
+        """[(lo, hi_or_None), ...]: shard k serves bar cursors in
+        [lo, hi); the final shard serves to the end (hi=None)."""
+        out = []
+        for k, lo in enumerate(self.starts):
+            hi = self.starts[k + 1] if k + 1 < len(self.starts) else None
+            out.append((lo, hi))
+        return out
+
+    def _device_shard(self, k: int) -> MarketData:
+        import jax
+
+        shard = shard_market_data(
+            self.host_data, self.starts[k], self.shard_bars, self.window_size
+        )
+        # device_put on host numpy is async: it enqueues the transfer
+        # and returns immediately — the double buffer.
+        return jax.tree.map(jax.device_put, shard)
+
+    def iter_shards(self):
+        """Yield ``(serve_lo, serve_hi_or_None, device_shard)`` in
+        order, with shard ``k+1``'s transfer already enqueued before
+        shard ``k`` is handed to the caller for compute."""
+        nxt = self._device_shard(0)
+        for k in range(len(self.starts)):
+            cur = nxt
+            if k + 1 < len(self.starts):
+                nxt = self._device_shard(k + 1)
+            hi = self.starts[k + 1] if k + 1 < len(self.starts) else None
+            yield self.starts[k], hi, cur
 
 
 def load_dataframe(config: Dict[str, Any]) -> pd.DataFrame:

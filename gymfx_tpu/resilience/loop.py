@@ -64,7 +64,9 @@ class ResilientLoop:
             else None
         )
         self.last_checkpoint_step: Optional[int] = None
-        self._pending: Optional[Tuple[int, Dict[str, Any]]] = None
+        # (it_start, k, guard metrics) — scalars for k == 1, stacked
+        # (k,) arrays for a fused superstep
+        self._pending: Optional[Tuple[int, int, Dict[str, Any]]] = None
 
     # ------------------------------------------------------------------
     def _save(self, state_fn: StateFn, step: int) -> None:
@@ -80,38 +82,68 @@ class ResilientLoop:
     def _check_pending(self, state_fn: StateFn) -> None:
         if self.monitor is None or self._pending is None:
             return
-        it, guard_metrics = self._pending
+        import numpy as np
+
+        it_start, k, guard_metrics = self._pending
         self._pending = None
+        # ONE host fetch per superstep: each guard counter arrives as a
+        # stacked (k,) array ((1,) for the per-step path) and the
+        # monitor replays the per-iteration deltas from it
+        host = {
+            key: np.ravel(np.asarray(value))
+            for key, value in guard_metrics.items()
+        }
         try:
-            self.monitor.update(guard_metrics, step=it)
+            for j in range(k):
+                self.monitor.update(
+                    {key: arr[j] for key, arr in host.items()},
+                    step=it_start + j,
+                )
         except NonFiniteDivergenceError:
             # params are still the last finite values (the in-graph
             # guard kept them) — persist them for the post-mortem/resume
             if self.checkpoint_dir:
                 self._save(
-                    state_fn, self.step_offset + (it + 1) * self.steps_per_iter
+                    state_fn,
+                    self.step_offset + (it_start + k) * self.steps_per_iter,
                 )
             raise
 
     # ------------------------------------------------------------------
-    def after_step(self, it: int, metrics: Dict[str, Any],
-                   state_fn: StateFn) -> None:
+    def after_superstep(self, it_start: int, k: int, metrics: Dict[str, Any],
+                        state_fn: StateFn) -> None:
+        """Superstep-aware hook: call once after dispatching iterations
+        ``[it_start, it_start + k)`` as one fused dispatch.  ``metrics``
+        carries the per-iteration guard counters stacked on a leading
+        ``(k,)`` axis (plain scalars are fine when ``k == 1``).
+        ``after_step(it, m, fn)`` is exactly
+        ``after_superstep(it, 1, m, fn)``.
+
+        With ``k > 1`` checkpoints land on the first superstep boundary
+        at/after each ``checkpoint_every`` multiple (only boundary
+        states exist on the host), and the simulated preemption fires on
+        the first boundary reaching ``preempt_at``.
+        """
+        it_end = it_start + k
         if self.monitor is not None:
             self._check_pending(state_fn)
             self._pending = (
-                it,
-                {k: metrics[k] for k in GUARD_METRIC_KEYS if k in metrics},
+                it_start,
+                k,
+                {key: metrics[key] for key in GUARD_METRIC_KEYS if key in metrics},
             )
         if (
             self.checkpoint_dir
             and self.checkpoint_every > 0
-            and (it + 1) % self.checkpoint_every == 0
+            and it_end // self.checkpoint_every > it_start // self.checkpoint_every
         ):
-            self._save(
-                state_fn, self.step_offset + (it + 1) * self.steps_per_iter
-            )
-        if self.preempt_at is not None and it + 1 >= self.preempt_at:
-            raise SimulatedPreemptionError(it + 1)
+            self._save(state_fn, self.step_offset + it_end * self.steps_per_iter)
+        if self.preempt_at is not None and it_end >= self.preempt_at:
+            raise SimulatedPreemptionError(it_end)
+
+    def after_step(self, it: int, metrics: Dict[str, Any],
+                   state_fn: StateFn) -> None:
+        self.after_superstep(it, 1, metrics, state_fn)
 
     def finish(self, state_fn: StateFn) -> None:
         """Flush the one-step-delayed watchdog after the loop ends."""

@@ -7,6 +7,67 @@ import jax
 import jax.numpy as jnp
 
 
+def make_train_many(step_impl):
+    """Superstep driver: jitted ``train_many(state, k)`` running ``k``
+    fused train steps in ONE donated dispatch.
+
+    ``step_impl(state) -> (state, metrics)`` is the same per-step impl
+    the trainers jit as ``train_step``; here it becomes the body of a
+    ``lax.scan``, so the Python interpreter pays one dispatch (and the
+    caller one metrics fetch) per K steps instead of per step.  Metrics
+    come back stacked on a leading ``(k,)`` axis — accumulated on
+    device, including the resilience guard counters, and fetched once
+    per superstep.
+
+    ``k`` is static: each distinct K compiles once (the trainers use one
+    K for the whole run plus at most one remainder).
+    """
+
+    def impl(state, k: int):
+        def body(s, _):
+            return step_impl(s)
+
+        return jax.lax.scan(body, state, None, length=k)
+
+    return jax.jit(impl, static_argnums=1, donate_argnums=0)
+
+
+class DelayedLogger:
+    """One-dispatch-delayed ``log_every`` metrics printing.
+
+    The snapshot for iteration ``i`` is floated (held as device arrays)
+    and only converted to host floats after the NEXT dispatch has been
+    issued — the same pipelining trick as ResilientLoop's delayed guard
+    fetch, so logging never stalls the device pipeline with a hot host
+    sync.  ``finish()`` flushes the last held snapshot after the loop.
+    """
+
+    def __init__(self, tag: str, log_every: int, iters: int):
+        self.tag = str(tag)
+        self.every = int(log_every or 0)
+        self.iters = int(iters)
+        self._held: Optional[Tuple[int, Dict[str, Any]]] = None
+
+    def _flush(self) -> None:
+        if self._held is None:
+            return
+        it_end, metrics = self._held
+        self._held = None
+        snap = {k: float(v) for k, v in metrics.items()}
+        print(f"[{self.tag}] iter {it_end}/{self.iters} {snap}")
+
+    def after_dispatch(self, it_start: int, k: int, metrics: Dict[str, Any]) -> None:
+        """Call right after dispatching iterations
+        ``[it_start, it_start + k)``; ``metrics`` is the newest
+        iteration's (device) metrics tree."""
+        self._flush()
+        if self.every and (it_start + k) // self.every > it_start // self.every:
+            self._held = (it_start + k, metrics)
+
+    def finish(self) -> None:
+        self._flush()
+
+
 def build_train_eval_envs(config: Dict[str, Any]) -> Tuple[Any, Optional[Any]]:
     """(train_env, eval_env-or-None) honoring the out-of-sample keys.
 

@@ -117,12 +117,21 @@ class ImpalaTrainer:
             optax.clip_by_global_norm(icfg.max_grad_norm),
             optax.adam(icfg.lr),
         )
-        cfg, params, data = env.cfg, env.params, env.data
+        cfg, params = env.cfg, env.params
+        if hasattr(env, "require_resident_data"):
+            data = env.require_resident_data(
+                "IMPALA training (random-access rollouts)"
+            )
+        else:
+            data = env.data
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
         self._is_transformer = is_token_policy(icfg.policy)
         self._window = cfg.window_size
         self._reset_vec = self._encode(reset_obs)
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+        from gymfx_tpu.train.common import make_train_many
+
+        self._train_many = make_train_many(self._train_step_impl)
 
     def _encode(self, obs):
         if self._is_transformer:
@@ -386,6 +395,11 @@ class ImpalaTrainer:
     def train_step(self, state: ImpalaState):
         return self._train_step(state)
 
+    def train_many(self, state: ImpalaState, k: int):
+        """``k`` fused train steps in ONE donated dispatch; metrics come
+        back stacked on a leading ``(k,)`` axis (see PPOTrainer.train_many)."""
+        return self._train_many(state, int(k))
+
     def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0,
               initial_state: Optional[ImpalaState] = None,
               initial_params=None,
@@ -393,7 +407,8 @@ class ImpalaTrainer:
               checkpoint_every: int = 0, step_offset: int = 0,
               checkpoint_metadata: Optional[Dict[str, Any]] = None,
               max_consecutive_skips: int = 10,
-              preempt_at: Optional[int] = None):
+              preempt_at: Optional[int] = None,
+              supersteps_per_dispatch: int = 1):
         if initial_state is not None:
             state = initial_state
             if self.mesh is not None:
@@ -424,16 +439,29 @@ class ImpalaTrainer:
             ),
             preempt_at=preempt_at,
         )
+        from gymfx_tpu.train.common import DelayedLogger
+
+        K = max(1, int(supersteps_per_dispatch or 1))
+        logger = DelayedLogger("impala", log_every, iters)
         t0 = time.perf_counter()
         metrics: Dict[str, Any] = {}
-        for it in range(iters):
-            state, metrics = self.train_step(state)
-            hooks.after_step(
-                it, metrics, lambda: (state._asdict(), state.learner_params)
+        it = 0
+        while it < iters:
+            k = min(K, iters - it)
+            if k == 1:
+                state, metrics = self.train_step(state)
+                guard_metrics = metrics
+            else:
+                state, stacked = self.train_many(state, k)
+                metrics = jax.tree.map(lambda x: x[-1], stacked)
+                guard_metrics = stacked
+            hooks.after_superstep(
+                it, k, guard_metrics,
+                lambda: (state._asdict(), state.learner_params),
             )
-            if log_every and (it + 1) % log_every == 0:
-                print(f"[impala] iter {it + 1}/{iters} "
-                      f"{ {k: float(v) for k, v in metrics.items()} }")
+            logger.after_dispatch(it, k, metrics)
+            it += k
+        logger.finish()
         hooks.finish(lambda: (state._asdict(), state.learner_params))
         jax.block_until_ready(state.learner_params)
         dt = time.perf_counter() - t0
@@ -482,6 +510,9 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
                              "policy_kwargs": dict(icfg.policy_kwargs)},
         max_consecutive_skips=int(
             config.get("guard_max_consecutive_skips", 10) or 0
+        ),
+        supersteps_per_dispatch=int(
+            config.get("supersteps_per_dispatch", 1) or 1
         ),
         preempt_at=profile.get("preempt_at"),
     )

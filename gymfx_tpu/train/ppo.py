@@ -133,7 +133,11 @@ class PPOTrainer:
         )
         self.optimizer = self._make_optimizer()
 
-        cfg, params, data = env.cfg, env.params, env.data
+        cfg, params = env.cfg, env.params
+        if hasattr(env, "require_resident_data"):
+            data = env.require_resident_data("PPO training (random-access rollouts)")
+        else:
+            data = env.data
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
         self._is_transformer = is_token_policy(pcfg.policy)
         self._window = cfg.window_size
@@ -142,6 +146,9 @@ class PPOTrainer:
 
         self._random_start = bool(env.config.get("random_episode_start", False))
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+        from gymfx_tpu.train.common import make_train_many
+
+        self._train_many = make_train_many(self._train_step_impl)
 
     # ------------------------------------------------------------------
     def _make_optimizer(self):
@@ -492,17 +499,31 @@ class PPOTrainer:
     def train_step(self, state: TrainState):
         return self._train_step(state)
 
+    def train_many(self, state: TrainState, k: int):
+        """``k`` fused train steps in ONE donated dispatch (lax.scan over
+        the per-step impl).  Returns ``(state, metrics)`` with every
+        metric stacked on a leading ``(k,)`` axis — accumulated on
+        device, fetched by the caller once per superstep."""
+        return self._train_many(state, int(k))
+
     def train(self, total_env_steps: int, seed: int = 0, log_every: int = 0,
               initial_params=None, initial_state: Optional[TrainState] = None,
               *, checkpoint_dir: Optional[str] = None,
               checkpoint_every: int = 0, step_offset: int = 0,
               checkpoint_metadata: Optional[Dict[str, Any]] = None,
               max_consecutive_skips: int = 10,
-              preempt_at: Optional[int] = None):
+              preempt_at: Optional[int] = None,
+              supersteps_per_dispatch: int = 1):
         """Run PPO for ~total_env_steps; log metrics every ``log_every``
         iterations when > 0.  ``initial_state`` continues a checkpointed
         run exactly (full TrainState: params + opt_state + env batch +
         RNG); ``initial_params`` is a params-only warm start.
+
+        ``supersteps_per_dispatch=K > 1`` drives the loop through
+        :meth:`train_many`: one donated dispatch (and one host metrics
+        fetch) per K iterations.  The iteration trajectory is
+        bit-identical to K=1; resilience checkpoints/preemption land on
+        superstep boundaries.
 
         Resilience hooks (resilience/loop.py): ``checkpoint_every > 0``
         auto-saves the full state every that many iterations (cumulative
@@ -538,16 +559,29 @@ class PPOTrainer:
             ),
             preempt_at=preempt_at,
         )
+        from gymfx_tpu.train.common import DelayedLogger
+
+        K = max(1, int(supersteps_per_dispatch or 1))
+        logger = DelayedLogger("ppo", log_every, iters)
         t0 = time.perf_counter()
         metrics = {}
-        for it in range(iters):
-            state, metrics = self.train_step(state)
-            hooks.after_step(
-                it, metrics, lambda: (state._asdict(), state.params)
+        it = 0
+        while it < iters:
+            k = min(K, iters - it)
+            if k == 1:
+                state, metrics = self.train_step(state)
+                guard_metrics = metrics
+            else:
+                state, stacked = self.train_many(state, k)
+                # newest iteration's metrics, still on device (no sync)
+                metrics = jax.tree.map(lambda x: x[-1], stacked)
+                guard_metrics = stacked
+            hooks.after_superstep(
+                it, k, guard_metrics, lambda: (state._asdict(), state.params)
             )
-            if log_every and (it + 1) % log_every == 0:
-                snap = {k: float(v) for k, v in metrics.items()}
-                print(f"[ppo] iter {it + 1}/{iters} {snap}")
+            logger.after_dispatch(it, k, metrics)
+            it += k
+        logger.finish()
         hooks.finish(lambda: (state._asdict(), state.params))
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
@@ -690,6 +724,9 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
             config.get("guard_max_consecutive_skips", 10) or 0
         ),
         preempt_at=profile.get("preempt_at"),
+        supersteps_per_dispatch=int(
+            config.get("supersteps_per_dispatch", 1) or 1
+        ),
     )
 
     # out-of-sample: greedy episode on bars the agent never trained on

@@ -1,0 +1,33 @@
+"""Tier-1 smoke for the benchmark contract: ``python bench.py --quick``
+must exit 0 on CPU and end its stdout with the single JSON line
+(metric / value / vs_baseline) that downstream dashboards parse
+unconditionally (docs/performance.md, Benchmark contract)."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_bench_quick_prints_single_json_line_contract():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # share the suite's persistent compile cache so the smoke pays the
+    # big PPO program's compile at most once across CI runs
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gymfx_jax_cache")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--quick"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"bench printed nothing to stdout: {proc.stderr[-2000:]}"
+    payload = json.loads(lines[-1])  # the contract: final line IS the JSON
+    for key in ("metric", "value", "vs_baseline"):
+        assert key in payload, (key, payload)
+    assert payload["metric"] == "ppo_env_steps_per_sec_per_chip"
+    assert payload["value"] > 0
+    assert payload["supersteps"] == 1
+    assert payload["dispatch_overhead_frac"] is None  # K=1: no comparison

@@ -19,6 +19,20 @@ from gymfx_tpu.simulation.replay import ReplayAdapter
 from gymfx_tpu.simulation.fixtures import default_profile
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """Deserializing this module's large vmapped portfolio programs from
+    a WARM jax persistent compile cache segfaults the CPU backend
+    (CHANGES.md, PR 1 post-mortem: cache deserialization, not GC).
+    Disable the persistent cache for exactly this module — tests here
+    compile fresh every run and no other module's caching changes."""
+    import jax
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", True)
+
+
 def _write_pair_csv(path, closes, highs=None, lows=None, opens=None,
                     start="2024-03-05 09:30:00"):
     closes = np.asarray(closes, np.float64)

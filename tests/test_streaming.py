@@ -1,0 +1,130 @@
+"""Double-buffered bar streaming (docs/performance.md): when the bar
+history exceeds ``stream_hbm_budget_mb``, the Environment serves
+rollouts through BarStreamer shards whose ``row0`` rebases the env
+kernel's GLOBAL cursor — the contract under test is that a rollout
+forced through >= 3 shards is BIT-IDENTICAL to the fully-resident
+path, and that random-access consumers (trainers, reset/step) reject a
+streaming Environment loudly instead of thrashing transfers."""
+import numpy as np
+import pytest
+
+from gymfx_tpu.core.rollout import DRIVERS
+from tests.helpers import make_env, uptrend_df
+
+N_BARS = 200
+TINY_BUDGET = 0.001  # MiB — forces min_shard_bars=64 shards on 200 bars
+
+
+def _envs(n=N_BARS, **over):
+    df = uptrend_df(n)
+    resident = make_env(df, **over)
+    streaming = make_env(df, stream_hbm_budget_mb=TINY_BUDGET, **over)
+    return resident, streaming
+
+
+def test_streamer_plan_covers_history_with_three_plus_shards():
+    _, env = _envs()
+    assert env.streaming
+    st = env.streamer
+    assert st.num_shards >= 3
+    ranges = st.serve_ranges()
+    # serve ranges tile the cursor space: contiguous, start at 0, the
+    # final shard serves to the end
+    assert ranges[0][0] == 0
+    for (lo, hi), (lo2, _hi2) in zip(ranges, ranges[1:]):
+        assert hi == lo2
+    assert ranges[-1][1] is None
+    # every shard's slice stays inside the dataset (the final anchor
+    # overlaps its predecessor instead of shrinking: uniform shapes)
+    for lo, _hi in ranges:
+        assert lo + st.shard_bars + 1 <= st.n_bars
+
+
+@pytest.mark.parametrize("mode", ["buy_hold", "random", "flat"])
+def test_streamed_rollout_bit_identical_to_resident(mode):
+    import jax
+
+    resident, streaming = _envs()
+    driver = DRIVERS[mode]()
+    steps = N_BARS - 1  # full episode; cursor crosses every shard
+    s_ref, out_ref = resident.rollout(driver, steps, seed=0)
+    s_str, out_str = streaming.rollout(driver, steps, seed=0)
+    assert set(out_ref) == set(out_str)
+    for key in out_ref:
+        np.testing.assert_array_equal(
+            np.asarray(out_ref[key]), np.asarray(out_str[key]),
+            err_msg=f"outputs[{key}] ({mode})",
+        )
+    for i, (a, b) in enumerate(
+        zip(jax.tree.leaves(s_ref), jax.tree.leaves(s_str))
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"state leaf {i} ({mode})"
+        )
+
+
+def test_budget_large_enough_stays_resident_and_identical():
+    import jax
+
+    df = uptrend_df(N_BARS)
+    default = make_env(df)
+    budgeted = make_env(df, stream_hbm_budget_mb=1024)
+    assert not budgeted.streaming
+    for a, b in zip(jax.tree.leaves(default.data), jax.tree.leaves(budgeted.data)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shard_slices_rebase_row0_and_bounds_check():
+    from gymfx_tpu.data.feed import shard_market_data
+
+    env = make_env(uptrend_df(100))
+    data = env.data
+    shard = shard_market_data(data, 32, 20, env.cfg.window_size)
+    assert int(shard.row0) == 32
+    # bar arrays: shard_bars + 1 lookahead row; padded: + window rows;
+    # scaler moment tables: one extra lookahead row (they are (n+1)-row
+    # tables indexed at min(t+1, n))
+    assert shard.close.shape[0] == 21
+    assert shard.padded_close.shape[0] == 21 + env.cfg.window_size
+    assert shard.feat_mean.shape[0] == 22
+    np.testing.assert_array_equal(
+        np.asarray(shard.close), np.asarray(data.close[32:53])
+    )
+    with pytest.raises(ValueError, match="exceeds dataset"):
+        shard_market_data(data, 90, 20, env.cfg.window_size)
+
+
+def test_streamer_rejects_dataset_that_fits_the_budget():
+    from gymfx_tpu.data.feed import BarStreamer
+
+    env = make_env(uptrend_df(60))
+    with pytest.raises(ValueError, match="fits the .* budget"):
+        # 60 bars < min shard of 64: nothing to stream
+        BarStreamer(env.data, window_size=env.cfg.window_size,
+                    budget_mb=TINY_BUDGET)
+
+
+def test_streaming_env_rejects_random_access_consumers():
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    _, env = _envs(num_envs=4, ppo_horizon=8, ppo_epochs=1,
+                   ppo_minibatches=1, policy_kwargs={"hidden": [16, 16]})
+    with pytest.raises(ValueError, match="stream_hbm_budget_mb"):
+        env.reset()
+    config = dict(DEFAULT_VALUES)
+    config.update(env.config)
+    with pytest.raises(ValueError, match="stream_hbm_budget_mb"):
+        PPOTrainer(env, ppo_config_from(config))
+
+
+def test_streaming_env_rejects_impala_trainer():
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.train.impala import ImpalaTrainer, impala_config_from
+
+    _, env = _envs(num_envs=4, impala_unroll=8, policy="mlp",
+                   policy_kwargs={})
+    config = dict(DEFAULT_VALUES)
+    config.update(env.config)
+    with pytest.raises(ValueError, match="stream_hbm_budget_mb"):
+        ImpalaTrainer(env, impala_config_from(config))
