@@ -11,7 +11,6 @@ Usage: python bench.py [--n_envs N] [--horizon T] [--iters K] [--quick]
 """
 import argparse
 import json
-import os
 import sys
 
 # Honor JAX_PLATFORMS=cpu even where sitecustomize force-registers a
@@ -20,40 +19,6 @@ import sys
 from gymfx_tpu.bench_util import ensure_cpu_if_requested
 
 ensure_cpu_if_requested()
-
-
-def _probe_device(timeout_s: int = 240) -> None:
-    """Fail fast with a diagnostic JSON line when the accelerator is
-    unreachable.  A wedged device tunnel blocks the first device op
-    inside the C++ runtime, where Python signal handlers never run —
-    so the watchdog is a daemon timer that prints and hard-exits.
-    Only the probe is timed: a slow-but-healthy benchmark run is
-    never killed."""
-    import threading
-
-    def on_timeout():
-        print(
-            json.dumps(
-                {
-                    "metric": "ppo_env_steps_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": "env steps/sec/chip (BENCH ABORTED: device "
-                            "probe timed out — accelerator unreachable)",
-                    "vs_baseline": 0.0,
-                }
-            ),
-            flush=True,
-        )
-        os._exit(0)
-
-    timer = threading.Timer(timeout_s, on_timeout)
-    timer.daemon = True
-    timer.start()
-    import jax
-    import jax.numpy as jnp
-
-    (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
-    timer.cancel()
 
 
 def main() -> None:
@@ -73,7 +38,13 @@ def main() -> None:
     if args.quick:
         args.n_envs, args.horizon, args.iters = 256, 32, 2
 
-    _probe_device()
+    from gymfx_tpu.bench_util import probe_device
+
+    probe_device(
+        "ppo_env_steps_per_sec_per_chip",
+        unit="env steps/sec/chip",
+        extra={"vs_baseline": 0.0},
+    )
 
     import jax
 

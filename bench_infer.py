@@ -1,0 +1,185 @@
+#!/usr/bin/env python3
+"""Serving benchmark — prints ONE JSON line.
+
+Workload: the serving stack (gymfx_tpu/serve/) on the north-star MLP
+policy — the AOT-compiled bucket ladder fed by the micro-batching
+scheduler.  Three numbers are measured off the same warm engine:
+
+  * sequential baseline: the PRE-ENGINE live path — one jitted
+    batch-of-1 ``apply_seq`` dispatch plus a host argmax per decision;
+  * bucketed throughput (the headline): a closed loop of full-batch
+    ``decide_batch`` dispatches — decisions/sec/chip;
+  * request latency: concurrent client threads submitting single
+    observations through the MicroBatcher; p50/p99 wall latency comes
+    from its per-request records (enqueue -> resolve).
+
+Usage: python bench_infer.py [--policy P] [--batch N] [--iters K]
+                             [--clients C] [--wait_ms W] [--quick]
+"""
+import argparse
+import json
+import sys
+
+# Honor JAX_PLATFORMS=cpu even where sitecustomize force-registers a
+# remote accelerator plugin that overrides the env var (the shared
+# workaround, parallel/mesh.py honor_jax_platforms_env).
+from gymfx_tpu.bench_util import ensure_cpu_if_requested
+
+ensure_cpu_if_requested()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--policy", default="mlp")
+    ap.add_argument("--batch", type=int, default=1024,
+                    help="closed-loop dispatch batch (throughput phase)")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--clients", type=int, default=64,
+                    help="concurrent client threads (latency phase)")
+    ap.add_argument("--requests", type=int, default=50,
+                    help="requests per client thread")
+    ap.add_argument("--wait_ms", type=float, default=2.0,
+                    help="micro-batcher coalescing window")
+    ap.add_argument("--batch_mode", default="auto",
+                    choices=("auto", "exact", "matmul"))
+    ap.add_argument("--quick", action="store_true", help="small shapes (CI)")
+    args = ap.parse_args()
+    buckets = None
+    if args.quick:
+        args.iters = 3
+        args.clients, args.requests = 8, 20
+        buckets = (1, 8, args.batch)  # lean ladder: CI pays 3 compiles
+        if args.batch_mode == "auto":
+            # the quick line is a THROUGHPUT smoke: use the GEMM mode
+            # everywhere (auto would pick the bit-exact sequential-row
+            # mode on CPU; parity is the test suite's job, not CI's)
+            args.batch_mode = "matmul"
+
+    from gymfx_tpu.bench_util import probe_device
+
+    probe_device(
+        "serve_decisions_per_sec_per_chip",
+        unit="decisions/sec/chip",
+        extra={"p50_ms": 0.0, "p99_ms": 0.0},
+    )
+
+    import time
+
+    import numpy as np
+    import jax
+
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.serve import MicroBatcher, engine_from_config
+
+    config = dict(DEFAULT_VALUES)
+    config.update(
+        input_data_file="examples/data/eurusd_sample.csv",
+        policy=args.policy,
+        serve_batch_mode=args.batch_mode,
+        window_size=32,
+    )
+    if buckets is not None:
+        config["serve_buckets"] = list(buckets)
+    config["serve_max_batch_wait_ms"] = args.wait_ms
+
+    t0 = time.perf_counter()
+    bundle = engine_from_config(config)  # warm: every bucket compiles here
+    engine = bundle.engine
+    boot_s = time.perf_counter() - t0
+
+    # request stream: the env's reset observation row plus bounded noise
+    # (row values never change the FLOPs, only keep caches honest)
+    base = np.asarray(bundle.encode(bundle.reset_obs), engine.obs_dtype)
+    rng = np.random.default_rng(0)
+    rows = base[None] + 0.01 * rng.standard_normal(
+        (args.batch, *engine.obs_shape)
+    ).astype(engine.obs_dtype)
+    carries = (
+        engine.initial_carry_batch(args.batch) if engine.recurrent else None
+    )
+
+    # --- sequential baseline: the pre-engine live path ------------------
+    # one jitted batch-of-1 dispatch + host argmax per decision — what
+    # live/oanda.py paid per tick before the serving stack existed
+    import jax.numpy as jnp
+
+    seq_n = min(args.batch, 64 if args.quick else 256)
+    carry1 = bundle.engine.policy.initial_carry(())
+    naive = jax.jit(engine.policy.apply_seq)
+    out0 = naive(engine.params, jnp.asarray(rows[0]), carry1)
+    jax.block_until_ready(out0)
+    t0 = time.perf_counter()
+    for i in range(seq_n):
+        out, _value, _c = naive(engine.params, jnp.asarray(rows[i]), carry1)
+        head = out[0] if engine.continuous else out
+        int(np.argmax(np.asarray(head)))
+    seq_per_sec = seq_n / (time.perf_counter() - t0)
+
+    # --- bucketed closed-loop throughput (headline) ---------------------
+    engine.decide_batch(rows, carries)  # touch once before timing
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        engine.decide_batch(rows, carries)
+    batched_per_sec = args.batch * args.iters / (time.perf_counter() - t0)
+
+    # --- micro-batched request latency ----------------------------------
+    import threading
+
+    batcher = MicroBatcher(engine, max_batch_wait_ms=args.wait_ms)
+
+    def client(cid: int) -> None:
+        carry = engine.initial_carry() if engine.recurrent else None
+        for j in range(args.requests):
+            fut = batcher.submit(rows[(cid + j) % args.batch], carry)
+            d = fut.result()
+            if engine.recurrent:
+                carry = d.carry
+
+    threads = [
+        threading.Thread(target=client, args=(c,)) for c in range(args.clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lat_wall = time.perf_counter() - t0
+    records = batcher.records
+    batcher.close()
+    lat_ms = np.asarray([r.latency_s for r in records]) * 1e3
+    coalesce = (
+        batcher.coalesced_total / batcher.dispatches
+        if batcher.dispatches
+        else 0.0
+    )
+
+    chips = max(1, jax.local_device_count())
+    print(
+        json.dumps(
+            {
+                "metric": "serve_decisions_per_sec_per_chip",
+                "value": round(batched_per_sec / chips, 1),
+                "unit": f"decisions/sec/chip ({args.policy} policy, "
+                        f"{engine.batch_mode} batching, bucket ladder "
+                        f"{list(engine.buckets)})",
+                "decisions_per_sec_per_chip": round(batched_per_sec / chips, 1),
+                "sequential_per_sec": round(seq_per_sec, 1),
+                "speedup_vs_sequential": round(
+                    batched_per_sec / max(seq_per_sec, 1e-9), 2
+                ),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                "requests": len(records),
+                "mean_coalesced_per_dispatch": round(coalesce, 1),
+                "late_compiles": engine.late_compiles,
+                "boot_compile_s": round(boot_s, 2),
+                "latency_throughput_per_sec": round(
+                    len(records) / lat_wall, 1
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,44 @@ def ensure_cpu_if_requested() -> None:
     honor_jax_platforms_env()
 
 
+def probe_device(
+    metric: str,
+    *,
+    unit: str = "",
+    timeout_s: int = 240,
+    extra: Optional[dict] = None,
+) -> None:
+    """Fail fast with a diagnostic JSON line when the accelerator is
+    unreachable.  A wedged device tunnel blocks the first device op
+    inside the C++ runtime, where Python signal handlers never run —
+    so the watchdog is a daemon timer that prints (in the calling
+    benchmark's own metric schema, hence the parameters) and
+    hard-exits.  Only the probe is timed: a slow-but-healthy benchmark
+    run is never killed."""
+    import json
+    import os
+    import threading
+
+    def on_timeout():
+        record = {
+            "metric": metric,
+            "value": 0.0,
+            "unit": f"{unit} (BENCH ABORTED: device probe timed out — "
+                    "accelerator unreachable)",
+        }
+        record.update(extra or {})
+        print(json.dumps(record), flush=True)
+        os._exit(0)
+
+    timer = threading.Timer(timeout_s, on_timeout)
+    timer.daemon = True
+    timer.start()
+    import jax.numpy as jnp
+
+    (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    timer.cancel()
+
+
 # 20 timed iterations by default: each dispatch pays ~10ms host->device
 # round-trip over the remote-device tunnel, so short runs understate
 # steady-state throughput by ~6% (measured r4: 7.05M at 5 iters vs

@@ -60,4 +60,11 @@ def parse_args(argv=None):
     parser.add_argument("--supersteps_per_dispatch", type=int)
     parser.add_argument("--stream_hbm_budget_mb", type=float)
 
+    # serving flags (docs/serving.md); buckets as JSON, e.g. "[1,8,64]"
+    parser.add_argument("--serve_buckets", type=str)
+    parser.add_argument("--serve_max_batch_wait_ms", type=float)
+    parser.add_argument(
+        "--serve_batch_mode", choices=["auto", "exact", "matmul"]
+    )
+
     return parser.parse_known_args(argv)

@@ -108,4 +108,18 @@ DEFAULT_VALUES = {
     "live_retry_budget": 64,
     "live_breaker_threshold": 5,
     "live_breaker_recovery_time": 30.0,
+
+    # ---- serving (gymfx_tpu/serve/, docs/serving.md) ----
+    # AOT-compiled padded-batch ladder: every bucket compiles at boot so
+    # the decision path never traces (bench_infer.py)
+    "serve_buckets": [1, 8, 64, 512, 4096],
+    # micro-batcher coalescing window: max extra latency a request pays
+    # to share a dispatch with concurrent sessions
+    "serve_max_batch_wait_ms": 2.0,
+    # auto = matmul on TPU (MXU batching), exact elsewhere (responses
+    # bit-identical to the unbatched policy at every bucket size)
+    "serve_batch_mode": "auto",
+    # compile + run every bucket at engine construction (False defers
+    # to first use — only for tooling that never serves)
+    "serve_warmup": True,
 }

@@ -40,6 +40,55 @@ from gymfx_tpu.core.types import (
 CALENDAR_OBS_KEYS = tuple(k for k in CALENDAR_FEATURE_KEYS if k != "is_no_trade_window")
 
 
+def scale_feature_window(win, mean, std, neutral, cfg: "EnvConfig"):
+    """THE O(1) leakage-safe scaling of one (window, F) feature block:
+    z-score against the precomputed strictly-past moments, binary
+    passthrough columns, clip, nan_to_num — in exactly this op order.
+
+    Both obs producers go through this one definition — the training env
+    (:func:`build_obs`) and the serving featurizer
+    (serve/features.py, via the numpy twin below) — which is what makes
+    serving observations bit-identical to training observations."""
+    import jax.numpy as xp
+
+    scaled = xp.where(neutral, 0.0, (win - mean) / std)
+    if any(cfg.binary_mask):
+        mask = xp.asarray(cfg.binary_mask, dtype=bool)
+        scaled = xp.where(mask[None, :], win, scaled)
+    clip = cfg.feature_clip
+    if clip and clip > 0:
+        scaled = xp.clip(scaled, -clip, clip)
+    scaled = xp.nan_to_num(
+        scaled, nan=0.0, posinf=clip or 0.0, neginf=-(clip or 0.0)
+    )
+    return scaled.astype(xp.float32)
+
+
+def scale_feature_window_host(win, mean, std, neutral, cfg: "EnvConfig"):
+    """Numpy twin of :func:`scale_feature_window` for the serving hot
+    path (one request = one window; a device round trip per request
+    would dominate the latency budget).  Every op is the elementwise
+    IEEE-754 single-precision counterpart of the jnp version in the
+    same order, so the result is bit-identical
+    (tests/test_serve_features.py pins the two against each other)."""
+    import numpy as xp
+
+    win = xp.asarray(win, xp.float32)
+    mean = xp.asarray(mean, xp.float32)
+    std = xp.asarray(std, xp.float32)
+    scaled = xp.where(neutral, xp.float32(0.0), (win - mean) / std)
+    if any(cfg.binary_mask):
+        mask = xp.asarray(cfg.binary_mask, dtype=bool)
+        scaled = xp.where(mask[None, :], win, scaled)
+    clip = cfg.feature_clip
+    if clip and clip > 0:
+        scaled = xp.clip(scaled, xp.float32(-clip), xp.float32(clip))
+    scaled = xp.nan_to_num(
+        scaled, nan=0.0, posinf=clip or 0.0, neginf=-(clip or 0.0)
+    )
+    return scaled.astype(xp.float32)
+
+
 def build_obs(
     state: EnvState, data: MarketData, cfg: EnvConfig, params: EnvParams
 ) -> Dict[str, Any]:
@@ -54,17 +103,7 @@ def build_obs(
         mean = data.feat_mean[step - r0]
         std = data.feat_std[step - r0]
         neutral = data.feat_neutral[step - r0]
-        scaled = jnp.where(neutral, 0.0, (win - mean) / std)
-        if any(cfg.binary_mask):
-            mask = jnp.asarray(cfg.binary_mask, dtype=bool)
-            scaled = jnp.where(mask[None, :], win, scaled)
-        clip = cfg.feature_clip
-        if clip and clip > 0:
-            scaled = jnp.clip(scaled, -clip, clip)
-        scaled = jnp.nan_to_num(
-            scaled, nan=0.0, posinf=clip or 0.0, neginf=-(clip or 0.0)
-        )
-        obs["features"] = scaled.astype(jnp.float32)
+        obs["features"] = scale_feature_window(win, mean, std, neutral, cfg)
 
     price = data.close[state.t - r0]
     prices = None
@@ -86,7 +125,17 @@ def build_obs(
         obs["unrealized_pnl_norm"] = jnp.asarray(
             [unrealized / initial], dtype=jnp.float32
         )
-        remaining = jnp.maximum(0, n - (state.t + 1)) / max(1, n)
+        # explicit f32 reciprocal multiply instead of `/ n`: XLA rewrites
+        # a constant-divisor division into this multiply at runtime but
+        # constant-folds it to the correctly-rounded quotient when the
+        # cursor is static (reset_at with literal t0) — the explicit form
+        # produces the SAME bits on both paths, and on the serving host
+        # twin (serve/features.py)
+        import numpy as _np
+
+        remaining = jnp.maximum(0, n - (state.t + 1)) * (
+            _np.float32(1.0) / _np.float32(max(1, n))
+        )
         obs["steps_remaining_norm"] = jnp.asarray([remaining], dtype=jnp.float32)
 
     row = jnp.minimum(step, n - 1) - r0

@@ -518,3 +518,131 @@ class TargetOrderRouter:
             price_precision=self.price_precision,
             client_id=client_id,
         )
+
+
+class PolicyDecisionService:
+    """Warm policy serving glued to a :class:`TargetOrderRouter`.
+
+    The pre-engine live loop would have jit-traced the policy on the
+    FIRST market tick — a multi-second stall exactly when latency
+    matters most.  This service instead boots the serving stack at
+    router construction time:
+
+      * the AOT-compiled bucket ladder (serve/engine.py) compiles and
+        executes every bucket during ``__init__`` — after boot the
+        decision path never traces (``engine.late_compiles`` stays 0,
+        asserted by tests/test_live_serve.py);
+      * each bar is featurized on the host through the O(1) scaler path
+        (serve/features.py), producing observations bit-identical to
+        the training env's;
+      * the greedy decision is mapped to a pending target (signed
+        units) and routed through ``router.submit_target`` with a
+        per-bar decision id, inheriting the router's idempotent-resubmit
+        and halt semantics.
+
+    Action mapping (the env's discrete action set, core/env.py):
+    1 -> long ``+units``, 2 -> short ``-units``, 3 -> flat 0,
+    0 -> hold (keep the current target; nothing is routed).
+    Continuous policies are already thresholded to {0, 1, 2} by the
+    engine with the env's own coercion threshold.
+    """
+
+    def __init__(
+        self,
+        config: Dict[str, Any],
+        router: "TargetOrderRouter",
+        *,
+        bundle: Any = None,
+        params: Any = None,
+        env: Any = None,
+        units: Optional[float] = None,
+    ):
+        from gymfx_tpu.serve.engine import engine_from_config
+        from gymfx_tpu.serve.features import BarFeaturizer, make_host_encoder
+
+        if bundle is None:
+            # warm boot: every ladder bucket AOT-compiles and runs once
+            # here, before the first market tick exists
+            bundle = engine_from_config(
+                config, params=params, env=env, warmup=True
+            )
+        self.bundle = bundle
+        self.engine = bundle.engine
+        self.router = router
+        self.featurizer = BarFeaturizer.from_environment(bundle.env)
+        self.session = self.featurizer.new_session()
+        self._encode = make_host_encoder(
+            bundle.policy_name, bundle.env.cfg.window_size, bundle.obs_spec
+        )
+        self._carry = (
+            self.engine.initial_carry() if self.engine.recurrent else None
+        )
+        self.units = float(
+            units if units is not None else bundle.env.params.position_size
+        )
+        self.target_units = 0.0  # last routed pending target
+        self.decisions = 0
+
+    # ------------------------------------------------------------------
+    def decide(
+        self,
+        close: float,
+        features: Any = None,
+        *,
+        equity_delta: float = 0.0,
+    ):
+        """Featurize one bar and run the warm engine on it (no routing).
+
+        Returns the serve Decision row; recurrent carry streams in the
+        service between calls."""
+        self.session.push(close, features)
+        obs = self.session.obs(
+            pos_sign=float(
+                (self.target_units > 0) - (self.target_units < 0)
+            ),
+            equity_delta=equity_delta,
+        )
+        row = self._encode(obs)
+        decision = self.engine.decide(row, self._carry)
+        if self.engine.recurrent:
+            self._carry = decision.carry
+        self.decisions += 1
+        return decision
+
+    def decide_and_route(
+        self,
+        close: float,
+        features: Any = None,
+        *,
+        equity_delta: float = 0.0,
+        stop_loss: Optional[float] = None,
+        take_profit: Optional[float] = None,
+        decision_id: Optional[str] = None,
+    ):
+        """One live tick: featurize -> decide -> route the new target.
+
+        Returns ``(decision, order)``; ``order`` is None when the
+        decision holds the current target (nothing to route) or the
+        router found the book already at target."""
+        decision = self.decide(close, features, equity_delta=equity_delta)
+        action = int(decision.action)
+        if action == 1:
+            target = self.units
+        elif action == 2:
+            target = -self.units
+        elif action == 3:
+            target = 0.0
+        else:  # hold: keep the current pending target, no order traffic
+            return decision, None
+        if decision_id is None:
+            # bar cursor is unique per session, so resubmits of the same
+            # decision dedup through the router's client-id lookup
+            decision_id = f"bar{self.session.bars_seen}"
+        order = self.router.submit_target(
+            target,
+            stop_loss=stop_loss,
+            take_profit=take_profit,
+            decision_id=decision_id,
+        )
+        self.target_units = target
+        return decision, order

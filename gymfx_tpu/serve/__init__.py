@@ -1,0 +1,42 @@
+"""Batched low-latency policy serving (docs/serving.md).
+
+The serving counterpart of the training stack: an AOT-compiled,
+shape-bucketed forward pass (:mod:`engine`), a micro-batching scheduler
+coalescing concurrent requests into one dispatch (:mod:`batcher`), and
+a per-session O(1) featurizer producing observations bit-identical to
+the training env's (:mod:`features`)."""
+from gymfx_tpu.serve.batcher import MicroBatcher, RequestRecord
+from gymfx_tpu.serve.config import ServeConfig, serve_config_from
+from gymfx_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    Decision,
+    EngineBundle,
+    InferenceEngine,
+    engine_from_config,
+    resolve_batch_mode,
+)
+from gymfx_tpu.serve.features import (
+    BarFeaturizer,
+    BarSession,
+    flatten_obs_host,
+    make_host_encoder,
+    tokens_from_obs_host,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "BarFeaturizer",
+    "BarSession",
+    "Decision",
+    "EngineBundle",
+    "InferenceEngine",
+    "MicroBatcher",
+    "RequestRecord",
+    "ServeConfig",
+    "engine_from_config",
+    "flatten_obs_host",
+    "make_host_encoder",
+    "resolve_batch_mode",
+    "serve_config_from",
+    "tokens_from_obs_host",
+]

@@ -1,0 +1,204 @@
+"""Micro-batching scheduler: coalesce concurrent decide-action requests
+into one engine dispatch.
+
+Concurrent sessions (live instruments, replayed accounts, bench
+clients) each submit one encoded observation; a single worker thread
+coalesces whatever arrives within a bounded window into one
+``InferenceEngine.decide_batch`` call.  The latency contract:
+
+  * the window OPENS when the worker picks up the first queued request
+    and CLOSES ``max_batch_wait_ms`` later — or immediately, when the
+    batch reaches the engine's largest bucket (waiting longer could not
+    save a dispatch);
+  * therefore no request waits longer than ``max_batch_wait_ms`` plus
+    one in-flight dispatch (the worker picks it up as soon as the
+    previous batch returns), and with ``max_batch_wait_ms=0`` the
+    batcher degrades to dispatch-per-queue-drain;
+  * responses are unpadded by the engine and resolved per-request
+    through futures — a pad row has no future, so it can never leak.
+
+Per-request timing records (enqueue/pickup/dispatch/done) are kept for
+the latency satellites: tests/test_serve_batcher.py asserts the wait
+bound on them and bench_infer.py derives its p50/p99 from them.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, List, NamedTuple, Optional
+
+import numpy as np
+
+
+class RequestRecord(NamedTuple):
+    """Wall-clock trace of one request (time.perf_counter seconds)."""
+
+    t_enqueue: float    # submit() called
+    t_pickup: float     # worker opened the batching window
+    t_dispatch: float   # engine dispatch started
+    t_done: float       # response resolved
+    batch_size: int     # real requests coalesced with this one
+    bucket: int         # padded bucket the batch ran in
+
+    @property
+    def queue_wait_s(self) -> float:
+        return self.t_dispatch - self.t_enqueue
+
+    @property
+    def latency_s(self) -> float:
+        return self.t_done - self.t_enqueue
+
+
+class _Pending(NamedTuple):
+    obs: np.ndarray
+    carry: Any
+    future: Future
+    t_enqueue: float
+
+
+class MicroBatcher:
+    """One worker thread draining a request queue into engine dispatches.
+
+    Use as a context manager or call :meth:`close`; ``submit`` returns a
+    ``concurrent.futures.Future`` resolving to the request's
+    :class:`~gymfx_tpu.serve.engine.Decision` row.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch_wait_ms: float = 2.0,
+        max_batch: Optional[int] = None,
+        keep_records: int = 100_000,
+    ):
+        if max_batch_wait_ms < 0:
+            raise ValueError(
+                f"max_batch_wait_ms must be >= 0, got {max_batch_wait_ms}"
+            )
+        self.engine = engine
+        self.max_batch_wait_ms = float(max_batch_wait_ms)
+        self.max_batch = int(
+            engine.buckets[-1] if max_batch is None else max_batch
+        )
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
+        self._records: List[RequestRecord] = []
+        self._records_cap = int(keep_records)
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.coalesced_total = 0
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="gymfx-serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, obs_row: Any, carry: Any = None) -> Future:
+        """Enqueue one encoded observation (engine input row); returns a
+        Future of its Decision row.  ``carry`` is the session's
+        recurrent carry (required by recurrent engines; fresh sessions
+        pass ``engine.initial_carry()``)."""
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        if self.engine.recurrent and carry is None:
+            carry = self.engine.initial_carry()
+        fut: Future = Future()
+        self._queue.put(
+            _Pending(
+                np.asarray(obs_row, self.engine.obs_dtype),
+                carry,
+                fut,
+                time.perf_counter(),
+            )
+        )
+        return fut
+
+    @property
+    def records(self) -> List[RequestRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._worker.join()
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            first = self._queue.get()
+            if first is None:
+                return
+            t_pickup = time.perf_counter()
+            batch = [first]
+            deadline = t_pickup + self.max_batch_wait_ms / 1000.0
+            stop = False
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    stop = True
+                    break
+                batch.append(nxt)
+            self._dispatch(batch, t_pickup)
+            if stop:
+                return
+
+    def _dispatch(self, batch: List[_Pending], t_pickup: float) -> None:
+        import jax
+
+        n = len(batch)
+        obs = np.stack([p.obs for p in batch])
+        carries = (
+            jax.tree.map(lambda *xs: np.stack(xs), *[p.carry for p in batch])
+            if self.engine.recurrent
+            else None
+        )
+        t_dispatch = time.perf_counter()
+        try:
+            out = self.engine.decide_batch(obs, carries)
+        except BaseException as exc:  # resolve every waiter, then rethrow
+            for p in batch:
+                p.future.set_exception(exc)
+            raise
+        t_done = time.perf_counter()
+        bucket = self.engine.bucket_for(n)
+        for i, p in enumerate(batch):
+            p.future.set_result(
+                type(out)(
+                    out.action[i],
+                    out.value[i],
+                    out.actor_out[i],
+                    jax.tree.map(lambda x: x[i], out.carry)
+                    if self.engine.recurrent
+                    else out.carry,
+                )
+            )
+        with self._lock:
+            self.dispatches += 1
+            self.coalesced_total += n
+            if len(self._records) + n <= self._records_cap:
+                self._records.extend(
+                    RequestRecord(
+                        p.t_enqueue, t_pickup, t_dispatch, t_done, n, bucket
+                    )
+                    for p in batch
+                )

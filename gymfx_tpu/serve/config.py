@@ -1,0 +1,50 @@
+"""Serving config surface: the ``serve_*`` keys (config/defaults.py)
+parsed into one immutable struct shared by the engine constructor, the
+live decision service (live/oanda.py) and bench_infer.py."""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+from gymfx_tpu.serve.engine import DEFAULT_BUCKETS
+
+
+class ServeConfig(NamedTuple):
+    buckets: Tuple[int, ...]
+    max_batch_wait_ms: float
+    batch_mode: str   # auto | exact | matmul (engine.resolve_batch_mode)
+    warmup: bool
+
+
+def _parse_buckets(value: Any) -> Tuple[int, ...]:
+    """Bucket ladders arrive as real lists from file configs and as JSON
+    strings from the CLI passthrough (same convention as
+    feature_columns, core/runtime.py)."""
+    if value is None:
+        return DEFAULT_BUCKETS
+    if isinstance(value, str):
+        import json
+
+        try:
+            value = json.loads(value)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                "serve_buckets must be a JSON list of batch sizes "
+                f"(e.g. '[1, 8, 64]'), got {value!r}"
+            ) from e
+    if not isinstance(value, (list, tuple)) or not value:
+        raise ValueError(
+            f"serve_buckets must be a non-empty list of batch sizes, got {value!r}"
+        )
+    return tuple(sorted({int(b) for b in value}))
+
+
+def serve_config_from(config: Dict[str, Any]) -> ServeConfig:
+    wait = float(config.get("serve_max_batch_wait_ms", 2.0) or 0.0)
+    if wait < 0:
+        raise ValueError(f"serve_max_batch_wait_ms must be >= 0, got {wait}")
+    return ServeConfig(
+        buckets=_parse_buckets(config.get("serve_buckets")),
+        max_batch_wait_ms=wait,
+        batch_mode=str(config.get("serve_batch_mode", "auto") or "auto"),
+        warmup=bool(config.get("serve_warmup", True)),
+    )

@@ -1,0 +1,468 @@
+"""Batched low-latency policy inference: AOT-compiled bucket ladder.
+
+The training side fuses rollouts into one XLA dispatch per superstep
+(train/common.py); this module is the serving twin.  Instead of one
+jit-traced batch-of-1 dispatch per decision (the pre-engine live path:
+first tick pays the full trace, every tick pays a dispatch),
+``InferenceEngine``:
+
+  * AOT-lowers and pre-compiles the actor forward pass for a LADDER of
+    padded batch buckets (default 1/8/64/512/4096) at construction via
+    ``jax.jit(...).lower(...).compile()`` — boot pays every compile, the
+    serving path never traces;
+  * serves any request batch by padding it with neutral observations up
+    to the smallest covering bucket and unpadding the responses, so N
+    concurrent sessions share ONE device dispatch instead of N;
+  * donates the observation/carry input buffers on TPU (they are
+    rebuilt per dispatch, so XLA may reuse their HBM for the outputs);
+  * supports every policy family in train/policies.py through the
+    uniform ``apply_seq`` surface — recurrent policies stream their
+    (c, h) carry through the engine per session.
+
+Two in-graph batching modes (``batch_mode``):
+
+  ``exact``   rows are computed by a ``lax.map`` of the SINGLE-example
+      program — each response is bit-identical to the unbatched
+      ``policy.apply`` on the same observation, at every bucket size,
+      on every backend (tests/test_serve_engine.py).  One dispatch per
+      micro-batch; row compute is sequential in-graph.
+  ``matmul``  rows are vmapped into full-width batched GEMMs — the MXU
+      throughput mode.  Responses may differ from the unbatched matvec
+      program (and, on CPU, across bucket sizes) by float
+      reassociation where the backend picks per-shape GEMM
+      accumulation strategies; on TPU every bucket lowers to the same
+      MXU tiling, so rows are bit-stable across bucket sizes there.
+  ``auto``    ``matmul`` on TPU, ``exact`` elsewhere.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS: Tuple[int, ...] = (1, 8, 64, 512, 4096)
+
+
+class Decision(NamedTuple):
+    """One response row.  ``actor_out`` is the raw actor head output —
+    logits ``(n_actions,)`` for discrete policies, the Gaussian mean for
+    continuous ones — so callers can audit the decision; ``action`` is
+    the greedy env-action int (0 hold / 1 long / 2 short), already
+    thresholded for continuous policies the way the env coerces them."""
+
+    action: Any
+    value: Any
+    actor_out: Any
+    carry: Any
+
+
+def resolve_batch_mode(mode: str) -> str:
+    """'auto' -> 'matmul' on TPU (MXU batching), 'exact' elsewhere
+    (bit-identity guaranteed; CPU GEMM kernels reassociate)."""
+    if mode not in ("auto", "exact", "matmul"):
+        raise ValueError(
+            f"serve batch_mode must be auto|exact|matmul, got {mode!r}"
+        )
+    if mode != "auto":
+        return mode
+    import jax
+
+    return "matmul" if jax.default_backend() == "tpu" else "exact"
+
+
+class InferenceEngine:
+    """AOT-compiled, shape-bucketed batched policy forward pass.
+
+    Parameters
+    ----------
+    policy : a train/policies.py module (any family)
+    params : its variables (e.g. from train/checkpoint.py load_params)
+    example_obs_vec : one encoded observation — the flat ``(obs_dim,)``
+        vector (flatten_obs) or ``(window, token_dim)`` token block
+        (tokens_from_obs) — fixing the request shape/dtype
+    buckets : padded batch ladder; compiled at construction when
+        ``warmup=True`` (the default — serving must never trace)
+    batch_mode : 'auto' | 'exact' | 'matmul' (see module docstring)
+    continuous : the policy emits a (mu, log_std) Gaussian head; greedy
+        actions are thresholded at ``continuous_threshold`` exactly like
+        the env coerces continuous actions (core/env.py)
+    neutral_obs : the pad row (defaults to zeros — the scaled-feature
+        neutral); never visible in responses
+    donate : donate obs/carry input buffers to the executable
+        (default: only on TPU — CPU ignores donation with a warning)
+    """
+
+    def __init__(
+        self,
+        policy: Any,
+        params: Any,
+        example_obs_vec: Any,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        batch_mode: str = "auto",
+        continuous: bool = False,
+        continuous_threshold: float = 0.33,
+        neutral_obs: Optional[np.ndarray] = None,
+        donate: Optional[bool] = None,
+        warmup: bool = True,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        if not buckets:
+            raise ValueError("bucket ladder must not be empty")
+        self.policy = policy
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if self.buckets[0] < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {self.buckets}")
+        self.batch_mode = resolve_batch_mode(batch_mode)
+        self.continuous = bool(continuous)
+        self.continuous_threshold = float(continuous_threshold)
+        self.params = jax.device_put(params)
+
+        obs = np.asarray(example_obs_vec)
+        self.obs_shape = tuple(int(s) for s in obs.shape)
+        self.obs_dtype = np.dtype(obs.dtype)
+        if neutral_obs is None:
+            neutral_obs = np.zeros(self.obs_shape, self.obs_dtype)
+        self.neutral_obs = np.asarray(neutral_obs, self.obs_dtype)
+        if self.neutral_obs.shape != self.obs_shape:
+            raise ValueError(
+                f"neutral_obs shape {self.neutral_obs.shape} != "
+                f"observation shape {self.obs_shape}"
+            )
+
+        carry0 = policy.initial_carry(())
+        self._carry_leaves = jax.tree.leaves(carry0)
+        self.recurrent = len(self._carry_leaves) > 0
+        self._carry0 = jax.tree.map(lambda x: np.asarray(x), carry0)
+
+        if donate is None:
+            donate = jax.default_backend() == "tpu"
+        donate_argnums = (1, 2) if donate else ()
+
+        thr = jnp.float32(self.continuous_threshold)
+        cont = self.continuous
+
+        def single(params, obs_row, carry_row):
+            out, value, carry2 = policy.apply_seq(params, obs_row, carry_row)
+            if cont:
+                mu, _log_std = out
+                action = jnp.where(
+                    mu >= thr, 1, jnp.where(mu <= -thr, 2, 0)
+                ).astype(jnp.int32)
+                actor_out = mu
+            else:
+                action = jnp.argmax(out, axis=-1).astype(jnp.int32)
+                actor_out = out
+            return action, value, actor_out, carry2
+
+        if self.batch_mode == "exact":
+
+            def batched(params, obs_b, carry_b):
+                return jax.lax.map(
+                    lambda row: single(params, row[0], row[1]),
+                    (obs_b, carry_b),
+                )
+
+        else:
+
+            def batched(params, obs_b, carry_b):
+                return jax.vmap(single, in_axes=(None, 0, 0))(
+                    params, obs_b, carry_b
+                )
+
+        self._fwd = jax.jit(batched, donate_argnums=donate_argnums)
+        self._compiled: Dict[int, Any] = {}
+        # serialized against concurrent decide_batch callers: the
+        # executables are stateless but the late-compile bookkeeping and
+        # jax dispatch are cheapest kept single-file (the MicroBatcher
+        # owns the one dispatch thread in the serving topology anyway)
+        self._lock = threading.Lock()
+        self.late_compiles = 0  # compiles after boot — a warm engine has 0
+        if warmup:
+            self.warmup()
+
+    # ------------------------------------------------------------------
+    def _zero_batch(self, bucket: int):
+        obs = np.broadcast_to(
+            self.neutral_obs, (bucket, *self.obs_shape)
+        ).copy()
+        carry = self.initial_carry_batch(bucket)
+        return obs, carry
+
+    def initial_carry_batch(self, n: int):
+        """Fresh (zero) recurrent carry for ``n`` sessions, host-side."""
+        import jax
+
+        return jax.tree.map(
+            lambda x: np.broadcast_to(x, (n, *x.shape)).copy(), self._carry0
+        )
+
+    def initial_carry(self):
+        """Fresh per-session carry (host-side numpy leaves)."""
+        import jax
+
+        return jax.tree.map(np.copy, self._carry0)
+
+    def warmup(self) -> None:
+        """AOT-compile every ladder bucket and run each once (the first
+        execution also pays allocator/autotune setup).  Idempotent."""
+        for bucket in self.buckets:
+            if bucket in self._compiled:
+                continue
+            exe = self._fwd.lower(
+                self.params, *self._zero_batch(bucket)
+            ).compile()
+            # one throwaway execution per bucket: boot absorbs every
+            # first-call cost, the serving path never does
+            exe(self.params, *self._zero_batch(bucket))
+            self._compiled[bucket] = exe
+
+    @property
+    def executable_count(self) -> int:
+        return len(self._compiled)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket covering ``n`` requests (the largest
+        bucket when ``n`` exceeds the ladder — decide_batch then splits
+        the batch into max-bucket chunks)."""
+        if n < 1:
+            raise ValueError(f"batch size must be >= 1, got {n}")
+        for bucket in self.buckets:
+            if bucket >= n:
+                return bucket
+        return self.buckets[-1]
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, obs_pad: np.ndarray, carry_pad: Any, bucket: int):
+        exe = self._compiled.get(bucket)
+        if exe is None:
+            # never hit after warmup() with a covering ladder; counted so
+            # the zero-compiles-after-boot contract is testable
+            exe = self._fwd.lower(self.params, obs_pad, carry_pad).compile()
+            self._compiled[bucket] = exe
+            self.late_compiles += 1
+        return exe(self.params, obs_pad, carry_pad)
+
+    def decide_batch(self, obs_batch: Any, carries: Any = None):
+        """Decide for ``n`` concurrent requests in one device dispatch.
+
+        ``obs_batch``: (n, *obs_shape) stacked encoded observations (or
+        a sequence of rows).  ``carries``: stacked recurrent carry with
+        leading dim n (required for recurrent policies; must be None or
+        () otherwise).  Returns a :class:`Decision` of stacked numpy
+        arrays with leading dim exactly n — pad rows are computed and
+        discarded here, they can never leak to a caller.
+        """
+        import jax
+
+        obs = np.asarray(obs_batch, self.obs_dtype)
+        if obs.ndim == len(self.obs_shape):  # single row convenience
+            obs = obs[None]
+        if obs.shape[1:] != self.obs_shape:
+            raise ValueError(
+                f"obs batch shape {obs.shape} does not match "
+                f"(n, {', '.join(map(str, self.obs_shape))})"
+            )
+        n = int(obs.shape[0])
+        if self.recurrent:
+            if carries is None:
+                raise ValueError(
+                    "recurrent policy: decide_batch needs the stacked "
+                    "session carries (engine.initial_carry_batch(n) for "
+                    "fresh sessions)"
+                )
+            carry = jax.tree.map(lambda x: np.asarray(x), carries)
+        else:
+            carry = self._carry0
+
+        bucket = self.bucket_for(n)
+        if n > bucket:  # ladder exceeded: chunk by the largest bucket
+            outs = [
+                self.decide_batch(
+                    obs[i : i + bucket],
+                    jax.tree.map(lambda x: x[i : i + bucket], carry)
+                    if self.recurrent
+                    else None,
+                )
+                for i in range(0, n, bucket)
+            ]
+            return Decision(
+                *(
+                    jax.tree.map(lambda *xs: np.concatenate(xs), *field)
+                    if i == 3
+                    else np.concatenate(field)
+                    for i, field in enumerate(zip(*outs))
+                )
+            )
+
+        obs_pad = np.empty((bucket, *self.obs_shape), self.obs_dtype)
+        obs_pad[:n] = obs
+        obs_pad[n:] = self.neutral_obs
+        if self.recurrent:
+            pad_carry = self.initial_carry_batch(bucket)
+            carry_pad = jax.tree.map(
+                lambda full, got: _fill_rows(full, got, n), pad_carry, carry
+            )
+        else:
+            carry_pad = self._carry0
+
+        with self._lock:
+            action, value, actor_out, carry2 = self._dispatch(
+                obs_pad, carry_pad, bucket
+            )
+        action, value, actor_out, carry2 = jax.device_get(
+            (action, value, actor_out, carry2)
+        )
+        return Decision(
+            np.asarray(action)[:n],
+            np.asarray(value)[:n],
+            np.asarray(actor_out)[:n],
+            jax.tree.map(lambda x: np.asarray(x)[:n], carry2)
+            if self.recurrent
+            else carry2,
+        )
+
+    def decide(self, obs_vec: Any, carry: Any = None) -> Decision:
+        """Single-request convenience: one row through the bucket-1
+        executable (or the smallest bucket in the ladder)."""
+        import jax
+
+        carries = None
+        if self.recurrent:
+            if carry is None:
+                carry = self.initial_carry()
+            carries = jax.tree.map(lambda x: np.asarray(x)[None], carry)
+        out = self.decide_batch(np.asarray(obs_vec)[None], carries)
+        return Decision(
+            out.action[0],
+            out.value[0],
+            out.actor_out[0],
+            jax.tree.map(lambda x: x[0], out.carry)
+            if self.recurrent
+            else out.carry,
+        )
+
+
+def _fill_rows(full: np.ndarray, got: np.ndarray, n: int) -> np.ndarray:
+    full = np.asarray(full)
+    full[:n] = np.asarray(got, full.dtype)
+    return full
+
+
+# ---------------------------------------------------------------------------
+# construction from the training stack
+# ---------------------------------------------------------------------------
+class EngineBundle(NamedTuple):
+    """A warm engine plus everything needed to feed it requests."""
+
+    engine: "InferenceEngine"
+    env: Any              # the bound core.runtime.Environment
+    policy_name: str
+    obs_spec: Any         # train/policies.py ObsSpec
+    encode: Any           # obs dict -> engine input row (jnp encoder)
+    reset_obs: Any        # the env's reset observation (shape template)
+
+
+def engine_from_config(
+    config: Dict[str, Any],
+    *,
+    params: Optional[Any] = None,
+    env: Optional[Any] = None,
+    warmup: bool = True,
+) -> "EngineBundle":
+    """Build a warm engine (plus its featurizer inputs) from the merged
+    config dict — the one construction path shared by the live router
+    boot (live/oanda.py PolicyDecisionService) and bench_infer.py.
+
+    Resolves the policy exactly like the trainers (same
+    make_trainer_policy path, same encoded obs layout), loads params
+    from ``checkpoint_dir`` when present (honoring the checkpoint's
+    recorded architecture), else initializes fresh ones — a serving
+    stack must be bootable without a trained model for load tests.
+    """
+    import jax
+
+    from gymfx_tpu.core import env as env_core
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.serve.config import serve_config_from
+    from gymfx_tpu.train.policies import (
+        make_obs_encoder,
+        make_obs_spec,
+        make_trainer_policy,
+    )
+
+    scfg = serve_config_from(config)
+    if env is None:
+        env = Environment(config)
+    policy_name = str(config.get("policy") or "mlp")
+    policy_kwargs = dict(config.get("policy_kwargs") or {})
+    ckpt_dir = config.get("checkpoint_dir")
+    if ckpt_dir:
+        from gymfx_tpu.train.checkpoint import read_metadata
+
+        meta = read_metadata(str(ckpt_dir))
+        if not config.get("policy") and meta.get("policy"):
+            policy_name = str(meta["policy"])
+            policy_kwargs = dict(meta.get("policy_kwargs") or policy_kwargs)
+
+    dtype_name = str(config.get("policy_dtype", "float32"))
+    import jax.numpy as jnp
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    continuous = (
+        str(config.get("action_space_mode", "discrete")) == "continuous"
+    )
+    policy = make_trainer_policy(
+        policy_name,
+        continuous=continuous,
+        dtype=dtype,
+        kwargs=policy_kwargs,
+        window=env.cfg.window_size,
+    )
+
+    data = (
+        env.require_resident_data("serving boot (reset obs template)")
+        if hasattr(env, "require_resident_data")
+        else env.data
+    )
+    _state, reset_obs = env_core.reset(env.cfg, env.params, data)
+    spec = make_obs_spec(reset_obs)
+    encode = make_obs_encoder(policy_name, env.cfg.window_size, spec)
+    example_vec = np.asarray(encode(reset_obs))
+
+    if params is None:
+        if ckpt_dir:
+            from gymfx_tpu.train.checkpoint import load_params
+
+            params, _step = load_params(str(ckpt_dir))
+        else:
+            key = jax.random.PRNGKey(int(config.get("seed", 0) or 0))
+            carry0 = policy.initial_carry(())
+            if len(jax.tree.leaves(carry0)) > 0:
+                params = policy.init(key, example_vec, carry0)
+            else:
+                params = policy.init(key, example_vec)
+
+    engine = InferenceEngine(
+        policy,
+        params,
+        example_vec,
+        buckets=scfg.buckets,
+        batch_mode=scfg.batch_mode,
+        continuous=continuous,
+        continuous_threshold=float(
+            config.get("continuous_action_threshold", 0.33) or 0.33
+        ),
+        warmup=bool(warmup and scfg.warmup),
+    )
+    return EngineBundle(
+        engine=engine,
+        env=env,
+        policy_name=policy_name,
+        obs_spec=spec,
+        encode=encode,
+        reset_obs=reset_obs,
+    )

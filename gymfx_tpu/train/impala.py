@@ -36,6 +36,7 @@ from gymfx_tpu.train.policies import (
     flatten_obs,
     gaussian_entropy,
     is_token_policy,
+    make_obs_spec,
     make_trainer_policy,
     normal_logp,
     sample_normal,
@@ -127,6 +128,9 @@ class ImpalaTrainer:
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
         self._is_transformer = is_token_policy(icfg.policy)
         self._window = cfg.window_size
+        # static obs layout, derived once (see PPOTrainer: the encode
+        # hot path must not re-sort keys per call)
+        self.obs_spec = make_obs_spec(reset_obs)
         self._reset_vec = self._encode(reset_obs)
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
         from gymfx_tpu.train.common import make_train_many
@@ -134,9 +138,10 @@ class ImpalaTrainer:
         self._train_many = make_train_many(self._train_step_impl)
 
     def _encode(self, obs):
+        spec = getattr(self, "obs_spec", None)
         if self._is_transformer:
-            return tokens_from_obs(obs, self._window)
-        return flatten_obs(obs)
+            return tokens_from_obs(obs, self._window, spec)
+        return flatten_obs(obs, spec)
 
     def _forward(self, params, x, carry):
         if self.icfg.policy == "lstm":

@@ -12,16 +12,47 @@ compute dtype is configurable (bfloat16 on TPU, f32 reference path).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple
+import math
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 
-def flatten_obs(obs: Dict[str, Any]) -> Any:
-    """Dict obs -> flat feature vector (sorted key order, stable)."""
-    parts = [jnp.ravel(obs[k]).astype(jnp.float32) for k in sorted(obs.keys())]
+class ObsSpec(NamedTuple):
+    """Static layout of a Dict observation: the sorted key order plus
+    each block's shape and flat size, computed ONCE per env config.
+
+    The obs dict's structure is fixed by EnvConfig, so re-deriving
+    ``sorted(obs.keys())`` (and the per-key shapes) on every encode call
+    is pure overhead — at trace time in the training hot loop, and on
+    EVERY host-side request in the serving hot path (serve/engine.py).
+    Both paths take the spec instead."""
+
+    keys: Tuple[str, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    total_size: int
+
+
+def make_obs_spec(obs: Dict[str, Any]) -> ObsSpec:
+    """Derive the static flattening spec from one example obs dict."""
+    keys = tuple(sorted(obs.keys()))
+    shapes = tuple(
+        tuple(int(s) for s in jnp.shape(obs[k])) for k in keys
+    )
+    sizes = tuple(math.prod(shape) if shape else 1 for shape in shapes)
+    return ObsSpec(keys, shapes, sizes, sum(sizes))
+
+
+def flatten_obs(obs: Dict[str, Any], spec: Optional[ObsSpec] = None) -> Any:
+    """Dict obs -> flat feature vector (sorted key order, stable).
+
+    Pass the precomputed ``spec`` in hot paths (trainer encode, serving
+    featurize) so the key sort happens once per config, not per call."""
+    keys = spec.keys if spec is not None else tuple(sorted(obs.keys()))
+    parts = [jnp.ravel(obs[k]).astype(jnp.float32) for k in keys]
     return jnp.concatenate(parts, axis=0)
 
 
@@ -318,12 +349,15 @@ def seq_sharded_forward(policy, params, tokens, mesh, axis: str = "seq"):
     return fn(tokens)
 
 
-def tokens_from_obs(obs: Dict[str, Any], window: int) -> Any:
+def tokens_from_obs(obs: Dict[str, Any], window: int,
+                    spec: Optional[ObsSpec] = None) -> Any:
     """Obs dict -> (window, token_dim) token sequence for the
     TransformerPolicy: window-aligned blocks become per-bar token
-    features; scalar blocks broadcast along the window."""
+    features; scalar blocks broadcast along the window.  Pass the
+    precomputed ``spec`` in hot paths (see :func:`flatten_obs`)."""
+    keys = spec.keys if spec is not None else tuple(sorted(obs.keys()))
     cols = []
-    for k in sorted(obs.keys()):
+    for k in keys:
         v = obs[k]
         if v.ndim >= 1 and v.shape[0] == window:
             cols.append(v.reshape(window, -1).astype(jnp.float32))
@@ -331,6 +365,16 @@ def tokens_from_obs(obs: Dict[str, Any], window: int) -> Any:
             flat = jnp.ravel(v).astype(jnp.float32)
             cols.append(jnp.broadcast_to(flat[None, :], (window, flat.shape[0])))
     return jnp.concatenate(cols, axis=-1)
+
+
+def make_obs_encoder(policy_name: str, window: int, spec: ObsSpec):
+    """The one obs->policy-input encoding, shared by the trainers and
+    the serving engine: token policies get the (window, token_dim)
+    sequence, everything else the flat vector — both through the static
+    ``spec`` (no per-call key sort)."""
+    if is_token_policy(policy_name):
+        return lambda obs: tokens_from_obs(obs, window, spec)
+    return lambda obs: flatten_obs(obs, spec)
 
 
 class ContinuousMLPPolicy(nn.Module):

@@ -32,6 +32,7 @@ from gymfx_tpu.train.policies import (
     flatten_obs,
     gaussian_entropy,
     is_token_policy,
+    make_obs_spec,
     make_trainer_policy,
     normal_logp,
     sample_normal,
@@ -141,6 +142,10 @@ class PPOTrainer:
         self._reset_state, reset_obs = env_core.reset(cfg, params, data)
         self._is_transformer = is_token_policy(pcfg.policy)
         self._window = cfg.window_size
+        # static obs layout, derived once per env config: the encode hot
+        # path (traced per rollout step, and per request when serving)
+        # must not re-sort keys / re-derive shapes every call
+        self.obs_spec = make_obs_spec(reset_obs)
         self._reset_vec = self._encode(reset_obs)
         self.obs_dim = self._reset_vec.shape
 
@@ -158,9 +163,10 @@ class PPOTrainer:
         )
 
     def _encode(self, obs: Dict[str, Any]):
+        spec = getattr(self, "obs_spec", None)
         if self._is_transformer:
-            return tokens_from_obs(obs, self._window)
-        return flatten_obs(obs)
+            return tokens_from_obs(obs, self._window, spec)
+        return flatten_obs(obs, spec)
 
     def init_state(self, seed: int = 0) -> TrainState:
         state = self.init_state_from_key(jax.random.PRNGKey(seed))

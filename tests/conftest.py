@@ -7,6 +7,7 @@ float64; all library code uses explicit dtypes, so the float32 TPU path
 is still what gets tested unless a test opts in to f64.
 """
 import os
+import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -22,9 +23,17 @@ import jax  # noqa: E402
 # what actually pins tests to the local virtual-8-device CPU platform.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
-# Persistent compile cache: the suite compiles hundreds of distinct
-# programs on a 1-core box; caching them across runs cuts minutes.
-jax.config.update("jax_compilation_cache_dir", "/tmp/gymfx_jax_cache")
+# Persistent compile cache, FRESH per session: the suite compiles
+# hundreds of distinct programs on a 1-core box, and subprocess tests
+# (CLI roundtrips, bench smokes) reuse what the main process already
+# compiled via the exported env var.  The dir is never shared across
+# runs: deserializing large vmapped programs from a cache written by a
+# previous process generation corrupts the heap on the CPU backend and
+# segfaults at a random later allocation (PR 1 post-mortem; VERDICT.md
+# "reproducibly fixed by a fresh JAX_COMPILATION_CACHE_DIR").
+_cache_dir = tempfile.mkdtemp(prefix="gymfx_jax_cache.")
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pathlib  # noqa: E402

@@ -1,0 +1,37 @@
+"""Tier-1 smoke for the serving benchmark contract:
+``python bench_infer.py --quick`` must exit 0 on CPU and end its
+stdout with the single JSON line (decisions_per_sec_per_chip / p50_ms /
+p99_ms) that downstream dashboards parse unconditionally
+(docs/serving.md, Benchmark contract)."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_bench_infer_quick_prints_single_json_line_contract():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # share the suite's persistent compile cache so the smoke pays the
+    # bucket ladder's compiles at most once across CI runs
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gymfx_jax_cache")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench_infer.py"), "--quick"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert lines, f"bench printed nothing to stdout: {proc.stderr[-2000:]}"
+    payload = json.loads(lines[-1])  # the contract: final line IS the JSON
+    for key in ("metric", "value", "decisions_per_sec_per_chip",
+                "p50_ms", "p99_ms", "speedup_vs_sequential"):
+        assert key in payload, (key, payload)
+    assert payload["metric"] == "serve_decisions_per_sec_per_chip"
+    assert payload["decisions_per_sec_per_chip"] > 0
+    assert payload["p99_ms"] >= payload["p50_ms"] > 0
+    # the whole point of the engine: the warm boot absorbed every
+    # compile, the serving path never traced
+    assert payload["late_compiles"] == 0

@@ -1,0 +1,162 @@
+"""Live serving wiring (live/oanda.py PolicyDecisionService).
+
+The warm-boot contract: every bucket executable compiles during
+service construction, so the first market tick — and every tick after
+it — runs with ZERO compiles on the decision path.  Decisions route
+through the real TargetOrderRouter / OandaLiveBroker stack against a
+fake transport, so the venue payloads are asserted end-to-end.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from gymfx_tpu.live.oanda import (
+    OandaLiveBroker,
+    PolicyDecisionService,
+    TargetOrderRouter,
+)
+from gymfx_tpu.serve.engine import engine_from_config
+from helpers import make_df, make_env
+
+
+class FakeTransport:
+    """Records requests; replies from a programmable route table."""
+
+    def __init__(self):
+        self.calls = []
+        self.routes = {}
+
+    def route(self, method, path_part, status, payload):
+        self.routes[(method, path_part)] = (
+            status, json.dumps(payload).encode()
+        )
+
+    def __call__(self, method, url, headers, body):
+        self.calls.append(
+            {
+                "method": method,
+                "url": url,
+                "body": json.loads(body) if body else None,
+            }
+        )
+        for (m, part), (status, resp) in self.routes.items():
+            if m == method and part in url:
+                return status, resp
+        return 200, b"{}"
+
+
+def _stack(closes=None, **config_over):
+    if closes is None:
+        closes = 1.10 + 0.001 * np.sin(np.arange(48) * 0.4)
+    env = make_env(make_df(closes))
+    cfg = dict(env.config)
+    cfg.update(serve_buckets=[1, 4], **config_over)
+    t = FakeTransport()
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    broker = OandaLiveBroker("tok", "acct-1", transport=t)
+    router = TargetOrderRouter(broker, "EUR_USD")
+    bundle = engine_from_config(cfg, env=env)
+    svc = PolicyDecisionService(cfg, router, bundle=bundle, units=1000)
+    return svc, t, closes
+
+
+def test_boot_is_warm_and_ticks_never_compile():
+    svc, _t, closes = _stack()
+    assert svc.engine.executable_count == 2  # the whole ladder, at boot
+    assert svc.engine.late_compiles == 0
+    for i in range(5):
+        decision, _order = svc.decide_and_route(float(closes[i]))
+        assert decision.action in (0, 1, 2, 3)
+    # the first tick and every later one ran existing executables only
+    assert svc.engine.late_compiles == 0
+    assert svc.engine.executable_count == 2
+    assert svc.decisions == 5
+
+
+def test_actions_route_as_pending_targets(monkeypatch):
+    svc, t, closes = _stack()
+    # force the decision stream so every mapping branch is exercised
+    actions = iter([1, 0, 2, 3])
+    real_decide = svc.decide
+
+    def scripted(close, features=None, **kw):
+        d = real_decide(close, features, **kw)
+        return type(d)(np.int32(next(actions)), d.value, d.actor_out, d.carry)
+
+    monkeypatch.setattr(svc, "decide", scripted)
+
+    # action 1 -> long +units market order
+    _d, order = svc.decide_and_route(float(closes[0]), stop_loss=1.25)
+    post = t.calls[-1]
+    assert post["method"] == "POST" and "/orders" in post["url"]
+    assert post["body"]["order"]["units"] == "1000"
+    assert post["body"]["order"]["stopLossOnFill"]["price"] == "1.25000"
+    assert svc.target_units == 1000.0
+
+    # action 0 -> hold: target kept, NO venue traffic
+    n_calls = len(t.calls)
+    _d, order = svc.decide_and_route(float(closes[1]))
+    assert order is None
+    assert len(t.calls) == n_calls
+    assert svc.target_units == 1000.0
+
+    # action 2 -> short -units (router nets the delta from live position)
+    t.route("GET", "/openPositions", 200, {
+        "positions": [{"instrument": "EUR_USD",
+                       "long": {"units": "1000"}, "short": {"units": "0"}}]
+    })
+    _d, _order = svc.decide_and_route(float(closes[2]))
+    post = t.calls[-1]
+    assert post["body"]["order"]["units"] == "-2000"
+    assert svc.target_units == -1000.0
+
+    # action 3 -> flat: position close endpoint
+    _d, _order = svc.decide_and_route(float(closes[3]))
+    close_call = t.calls[-1]
+    assert close_call["method"] == "PUT"
+    assert "/positions/EUR_USD/close" in close_call["url"]
+    assert svc.target_units == 0.0
+
+
+def test_decision_ids_dedup_per_bar():
+    svc, t, closes = _stack()
+    captured = []
+    svc.router.submit_target = (  # capture the routed decision ids
+        lambda target, **kw: captured.append((target, kw["decision_id"]))
+    )
+    svc.decide = lambda close, features=None, **kw: _forced(svc, close, 1)
+    svc.decide_and_route(float(closes[0]))
+    svc.decide_and_route(float(closes[1]))
+    ids = [cid for _t2, cid in captured]
+    assert len(ids) == 2 and len(set(ids)) == 2  # unique per bar
+
+
+def _forced(svc, close, action):
+    svc.session.push(close)
+    from gymfx_tpu.serve.engine import Decision
+
+    return Decision(np.int32(action), np.float32(0), np.float32(0), ())
+
+
+def test_feature_configs_need_raw_rows():
+    rng = np.random.default_rng(5)
+    closes = 1.2 + 0.001 * np.cumsum(rng.standard_normal(40))
+    env = make_env(
+        make_df(closes, extra={"f1": rng.standard_normal(40)}),
+        feature_columns=["f1"],
+    )
+    cfg = dict(env.config)
+    cfg.update(serve_buckets=[1])
+    t = FakeTransport()
+    t.route("GET", "/openPositions", 200, {"positions": []})
+    router = TargetOrderRouter(OandaLiveBroker("tok", "a", transport=t),
+                               "EUR_USD")
+    svc = PolicyDecisionService(
+        cfg, router, bundle=engine_from_config(cfg, env=env), units=100
+    )
+    with pytest.raises(ValueError, match="feature columns"):
+        svc.decide_and_route(float(closes[0]))  # missing the raw row
+    d, _ = svc.decide_and_route(float(closes[1]), [0.5])
+    assert d.action in (0, 1, 2, 3)
+    assert svc.engine.late_compiles == 0

@@ -13,6 +13,21 @@ FILES = {
 }
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """Same flake family as test_portfolio_parity (CHANGES.md, PR 1
+    post-mortem): deserializing this module's large vmapped portfolio
+    programs from a WARM jax persistent compile cache corrupts the heap
+    on the CPU backend — the crash then surfaces at a random later
+    allocation (seen in pandas' CSV reader and in jax tracing).
+    Disable the persistent cache for exactly this module."""
+    import jax
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", True)
+
+
 def _env(**over):
     config = {"portfolio_files": FILES, "window_size": 8, "initial_cash": 10000.0}
     config.update(over)

@@ -1,0 +1,147 @@
+"""Micro-batching scheduler (gymfx_tpu/serve/batcher.py).
+
+The latency contract: concurrent requests coalesce into one dispatch;
+no request waits past ``max_batch_wait_ms`` once picked up (a full
+bucket closes the window early); pad rows can never leak into a
+response; recurrent carries stream per session through the futures.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gymfx_tpu.serve.batcher import MicroBatcher
+from gymfx_tpu.serve.engine import InferenceEngine
+from gymfx_tpu.train.policies import make_trainer_policy
+
+OBS_DIM = 10
+
+
+def _engine(name="mlp", buckets=(1, 8)):
+    kwargs = {"hidden": [16, 16]} if name == "mlp" else {"hidden": 16}
+    pol = make_trainer_policy(
+        name, continuous=False, dtype=jnp.float32, kwargs=kwargs, window=4
+    )
+    rng = np.random.default_rng(7)
+    example = rng.standard_normal(OBS_DIM).astype(np.float32)
+    carry0 = pol.initial_carry(())
+    key = jax.random.PRNGKey(1)
+    params = (
+        pol.init(key, jnp.asarray(example), carry0)
+        if jax.tree.leaves(carry0)
+        else pol.init(key, jnp.asarray(example))
+    )
+    return (
+        InferenceEngine(pol, params, example, buckets=buckets,
+                        batch_mode="exact"),
+        rng,
+    )
+
+
+def test_burst_coalesces_into_one_dispatch_with_exact_results():
+    eng, rng = _engine()
+    obs = rng.standard_normal((6, OBS_DIM)).astype(np.float32)
+    want = eng.decide_batch(obs)
+    # a generous window: all 6 submits land before the deadline closes
+    with MicroBatcher(eng, max_batch_wait_ms=250.0) as mb:
+        futs = [mb.submit(obs[i]) for i in range(6)]
+        got = [f.result(timeout=30) for f in futs]
+    assert mb.dispatches == 1
+    assert mb.coalesced_total == 6
+    for i, d in enumerate(got):
+        # distinct rows resolve to THEIR OWN decision — a pad row or a
+        # neighbor's response leaking would break one of these
+        assert np.array_equal(d.actor_out, want.actor_out[i]), i
+        assert np.array_equal(d.value, want.value[i]), i
+        assert int(d.action) == int(want.action[i]), i
+    rec = mb.records
+    assert len(rec) == 6
+    assert all(r.batch_size == 6 and r.bucket == 8 for r in rec)
+
+
+def test_full_bucket_closes_the_window_early():
+    eng, rng = _engine(buckets=(1, 4))
+    obs = rng.standard_normal((4, OBS_DIM)).astype(np.float32)
+    # a window so long that only the batch-full early close can explain
+    # the futures resolving promptly
+    with MicroBatcher(eng, max_batch_wait_ms=60_000.0, max_batch=4) as mb:
+        t0 = time.perf_counter()
+        futs = [mb.submit(obs[i]) for i in range(4)]
+        for f in futs:
+            f.result(timeout=30)
+        elapsed = time.perf_counter() - t0
+    assert elapsed < 30.0
+    assert mb.dispatches == 1
+
+
+def test_queue_wait_bound_holds_per_request():
+    eng, rng = _engine()
+    obs = rng.standard_normal((12, OBS_DIM)).astype(np.float32)
+    wait_ms = 50.0
+    with MicroBatcher(eng, max_batch_wait_ms=wait_ms) as mb:
+        futs = [mb.submit(obs[i % 12]) for i in range(12)]
+        for f in futs:
+            f.result(timeout=30)
+        records = mb.records
+    assert records
+    for r in records:
+        # the batching window itself never exceeds the configured wait
+        # (generous slack for CI scheduler jitter)
+        assert r.t_dispatch - r.t_pickup <= wait_ms / 1000.0 + 0.25, r
+        assert r.latency_s >= 0.0
+        assert r.queue_wait_s <= r.latency_s
+
+
+def test_recurrent_sessions_stream_carry_through_futures():
+    eng, rng = _engine("lstm", buckets=(1, 4))
+    obs = rng.standard_normal((2, OBS_DIM)).astype(np.float32)
+    ref = jax.jit(eng.policy.apply_seq)
+    c = eng.initial_carry()
+    with MicroBatcher(eng, max_batch_wait_ms=1.0) as mb:
+        carry = None  # None = fresh session (engine.initial_carry())
+        for t in range(2):
+            d = mb.submit(obs[t], carry).result(timeout=30)
+            carry = d.carry
+            o, v, c = ref(eng.params, obs[t], c)
+            assert np.array_equal(d.actor_out, np.asarray(o)), t
+            for got, want in zip(jax.tree.leaves(carry), jax.tree.leaves(c)):
+                assert np.array_equal(np.asarray(got), np.asarray(want)), t
+    assert eng.late_compiles == 0
+
+
+def test_concurrent_clients_all_resolve():
+    eng, rng = _engine()
+    obs = rng.standard_normal((16, OBS_DIM)).astype(np.float32)
+    want = eng.decide_batch(obs)
+    results = {}
+    with MicroBatcher(eng, max_batch_wait_ms=5.0) as mb:
+        def client(i):
+            results[i] = mb.submit(obs[i]).result(timeout=30)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert len(results) == 16
+    for i, d in results.items():
+        assert np.array_equal(d.actor_out, want.actor_out[i]), i
+    assert mb.coalesced_total == 16
+    assert mb.dispatches <= 16  # some coalescing must be possible
+
+
+def test_close_rejects_new_submits_and_validates_args():
+    eng, rng = _engine()
+    mb = MicroBatcher(eng, max_batch_wait_ms=1.0)
+    mb.close()
+    mb.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit(np.zeros(OBS_DIM, np.float32))
+    with pytest.raises(ValueError, match="max_batch_wait_ms"):
+        MicroBatcher(eng, max_batch_wait_ms=-1.0)
+    with pytest.raises(ValueError, match="max_batch"):
+        MicroBatcher(eng, max_batch=0)

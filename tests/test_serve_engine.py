@@ -1,0 +1,185 @@
+"""AOT-compiled bucket-ladder engine (gymfx_tpu/serve/engine.py).
+
+The serving contract (docs/serving.md): exact-mode batched responses
+are BITWISE identical to the jitted unbatched policy at every bucket
+size for every policy family (recurrent carries included); a warm
+engine never compiles on the decision path; pad rows never change a
+response; ladders smaller than the batch chunk transparently.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gymfx_tpu.serve.engine import InferenceEngine, resolve_batch_mode
+from gymfx_tpu.train.policies import make_trainer_policy
+
+OBS_DIM = 12
+WINDOW = 6
+TOKEN_DIM = 3
+BUCKETS = (1, 4, 8)
+
+_KWARGS = {
+    "mlp": {"hidden": [16, 16]},
+    "lstm": {"hidden": 16},
+    "transformer": {"d_model": 16, "n_heads": 2},
+}
+
+
+def _build(name, continuous=False, batch_mode="exact", buckets=BUCKETS):
+    pol = make_trainer_policy(
+        name,
+        continuous=continuous,
+        dtype=jnp.float32,
+        kwargs=dict(_KWARGS[name]),
+        window=WINDOW,
+    )
+    rng = np.random.default_rng(sum(map(ord, name)))
+    shape = (WINDOW, TOKEN_DIM) if name == "transformer" else (OBS_DIM,)
+    example = rng.standard_normal(shape).astype(np.float32)
+    carry0 = pol.initial_carry(())
+    key = jax.random.PRNGKey(0)
+    if jax.tree.leaves(carry0):
+        params = pol.init(key, jnp.asarray(example), carry0)
+    else:
+        params = pol.init(key, jnp.asarray(example))
+    eng = InferenceEngine(
+        pol,
+        params,
+        example,
+        buckets=buckets,
+        batch_mode=batch_mode,
+        continuous=continuous,
+    )
+    # the PARITY REFERENCE: the jitted unbatched program (what a
+    # batch-of-1 live loop would run) — exact mode must match its bits
+    ref = jax.jit(pol.apply_seq)
+    return pol, params, eng, ref, rng
+
+
+def _rows(rng, eng, n):
+    return rng.standard_normal((n, *eng.obs_shape)).astype(np.float32)
+
+
+def _nonzero_carries(eng, ref, params, rng, n):
+    """Per-row recurrent carries advanced one real step — parity must
+    hold mid-stream, not just from the zero carry."""
+    if not eng.recurrent:
+        return None
+    warm = _rows(rng, eng, n)
+    rows = []
+    for i in range(n):
+        _, _, c2 = ref(params, warm[i], eng.initial_carry())
+        rows.append(jax.tree.map(np.asarray, c2))
+    return jax.tree.map(lambda *xs: np.stack(xs), *rows)
+
+
+def _assert_bitwise(a, b, msg):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype, (msg, a.dtype, b.dtype)
+    assert np.array_equal(a, b), (msg, a, b)
+
+
+@pytest.mark.parametrize("name", ["mlp", "lstm", "transformer"])
+def test_exact_mode_bitwise_parity_every_bucket(name):
+    pol, params, eng, ref, rng = _build(name)
+    for n in (1, 3, 4, 8):  # exercises every bucket incl. padded fills
+        obs = _rows(rng, eng, n)
+        carries = _nonzero_carries(eng, ref, params, rng, n)
+        out = eng.decide_batch(obs, carries)
+        assert out.action.shape == (n,)
+        for i in range(n):
+            ci = (
+                jax.tree.map(lambda x: x[i], carries)
+                if eng.recurrent
+                else eng.initial_carry()
+            )
+            o, v, c2 = ref(params, obs[i], ci)
+            _assert_bitwise(out.actor_out[i], o, f"{name} actor row {i}")
+            _assert_bitwise(out.value[i], v, f"{name} value row {i}")
+            assert int(out.action[i]) == int(np.argmax(np.asarray(o)))
+            if eng.recurrent:
+                for got, want in zip(
+                    jax.tree.leaves(jax.tree.map(lambda x: x[i], out.carry)),
+                    jax.tree.leaves(c2),
+                ):
+                    _assert_bitwise(got, want, f"{name} carry row {i}")
+    assert eng.late_compiles == 0
+
+
+def test_warm_engine_never_compiles_after_boot():
+    _pol, _params, eng, _ref, rng = _build("mlp")
+    assert eng.executable_count == len(BUCKETS)
+    for n in (1, 2, 4, 5, 8):
+        eng.decide_batch(_rows(rng, eng, n))
+    eng.decide(_rows(rng, eng, 1)[0])
+    assert eng.late_compiles == 0
+    assert eng.executable_count == len(BUCKETS)  # no new programs
+
+
+def test_matmul_mode_rows_stable_across_buckets():
+    _pol, params, eng, ref, rng = _build("mlp", batch_mode="matmul")
+    row = _rows(rng, eng, 1)[0]
+    alone = eng.decide_batch(row[None])
+    for n in (3, 8):
+        batch = np.concatenate([row[None], _rows(rng, eng, n - 1)])
+        together = eng.decide_batch(batch)
+        # co-batched/pad rows must not perturb a response beyond the
+        # GEMM kernel's per-shape accumulation choice (bit-stable on
+        # TPU's fixed MXU tiling; CPU BLAS picks per-shape strategies)
+        np.testing.assert_allclose(
+            together.actor_out[0], alone.actor_out[0], rtol=1e-6, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            together.value[0], alone.value[0], rtol=1e-6, atol=1e-7
+        )
+    # matmul may reassociate vs the unbatched matvec program, but it
+    # must still be numerically the same decision function
+    o, v, _ = ref(params, row, ())
+    np.testing.assert_allclose(alone.actor_out[0], o, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(alone.value[0], v, rtol=1e-5, atol=1e-6)
+
+
+def test_ladder_overflow_chunks_without_compiling():
+    _pol, params, eng, ref, rng = _build("mlp", buckets=(1, 4))
+    obs = _rows(rng, eng, 11)  # > largest bucket: 4 + 4 + 3(padded)
+    out = eng.decide_batch(obs)
+    assert out.action.shape == (11,)
+    for i in range(11):
+        o, v, _ = ref(params, obs[i], ())
+        _assert_bitwise(out.actor_out[i], o, f"chunk row {i}")
+        _assert_bitwise(out.value[i], v, f"chunk row {i}")
+    assert eng.late_compiles == 0
+
+
+def test_continuous_actions_use_env_threshold():
+    _pol, _params, eng, _ref, rng = _build("mlp", continuous=True)
+    obs = _rows(rng, eng, 8)
+    out = eng.decide_batch(obs)
+    mu = np.asarray(out.actor_out)
+    want = np.where(mu >= 0.33, 1, np.where(mu <= -0.33, 2, 0))
+    assert np.array_equal(np.asarray(out.action), want)
+    d = eng.decide(obs[0])
+    assert int(d.action) == int(want[0])
+
+
+def test_input_validation():
+    _pol, _params, eng, _ref, rng = _build("mlp", buckets=(1, 4))
+    with pytest.raises(ValueError, match="batch size"):
+        eng.bucket_for(0)
+    with pytest.raises(ValueError, match="does not match"):
+        eng.decide_batch(np.zeros((2, OBS_DIM + 1), np.float32))
+    _pol2, _params2, eng2, ref2, rng2 = _build("lstm", buckets=(1,))
+    with pytest.raises(ValueError, match="carries"):
+        eng2.decide_batch(_rows(rng2, eng2, 2))
+    with pytest.raises(ValueError, match="bucket ladder"):
+        InferenceEngine(_pol, _params, np.zeros(OBS_DIM, np.float32), buckets=())
+
+
+def test_resolve_batch_mode():
+    with pytest.raises(ValueError, match="batch_mode"):
+        resolve_batch_mode("fast")
+    assert resolve_batch_mode("exact") == "exact"
+    assert resolve_batch_mode("matmul") == "matmul"
+    # the suite runs on CPU, where auto must pick the bit-exact mode
+    assert resolve_batch_mode("auto") == "exact"

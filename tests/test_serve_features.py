@@ -1,0 +1,166 @@
+"""Serving featurization (gymfx_tpu/serve/features.py).
+
+The bit-identity contract: replaying a bar stream through a
+:class:`BarSession` reproduces the training env's observation dict
+BITWISE at every bar — including the scaler warm-up region, binary
+passthrough columns, and all three scaling modes.  Replay alignment
+mirrors the env's step timing: reset consumes bar 0; the FIRST step is
+the warm-up (applies the action on the same bar, no advance); every
+later step advances one bar.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from gymfx_tpu.core import env as env_core
+from gymfx_tpu.core.obs import scale_feature_window, scale_feature_window_host
+from gymfx_tpu.serve.features import BarFeaturizer, make_host_encoder
+from helpers import make_df, make_env
+
+
+def _feature_df(n=24, seed=3):
+    rng = np.random.default_rng(seed)
+    closes = 1.10 + 0.002 * np.cumsum(rng.standard_normal(n))
+    return make_df(
+        closes,
+        extra={
+            "f1": rng.standard_normal(n) * 3.0 + 1.0,
+            "f2": np.abs(rng.standard_normal(n)) * 50.0,
+            "b1": (rng.random(n) > 0.5).astype(np.float64),
+        },
+    )
+
+
+def _assert_obs_bitwise(env_obs, served, where):
+    env_obs = {k: np.asarray(v) for k, v in env_obs.items()}
+    assert set(env_obs) == set(served), (where, set(env_obs) ^ set(served))
+    for k in env_obs:
+        got = np.asarray(served[k])
+        assert got.dtype == env_obs[k].dtype, (where, k)
+        assert np.array_equal(got, env_obs[k], equal_nan=True), (
+            where, k, got, env_obs[k],
+        )
+
+
+def _replay(env, df, n_steps=12):
+    """Drive the env (hold actions) and the featurizer off the same bar
+    stream; every published obs must match bitwise."""
+    data = env.data
+    cfg, params = env.cfg, env.params
+    cols = env.config["feature_columns"]
+    raw = df[list(cols)].to_numpy(np.float64) if cols else None
+    closes = df["CLOSE"].to_numpy(np.float64)
+    n = cfg.n_bars
+
+    sess = BarFeaturizer.from_environment(env).new_session()
+    state, obs = env_core.reset(cfg, params, data)
+    sess.push(closes[0], raw[0] if raw is not None else None)
+    _assert_obs_bitwise(obs, sess.obs(total_bars=n), "reset")
+
+    for k in range(n_steps):
+        state, obs, _r, _done, _info = env_core.step(
+            cfg, params, data, state, 0
+        )
+        if k >= 1:  # the first step is the no-advance warm-up
+            sess.push(closes[k], raw[k] if raw is not None else None)
+        _assert_obs_bitwise(obs, sess.obs(total_bars=n), f"step {k}")
+
+
+def test_rolling_zscore_replay_is_bitwise_identical():
+    df = _feature_df()
+    env = make_env(
+        df,
+        feature_columns=["f1", "f2", "b1"],
+        feature_binary_columns=["b1"],
+        feature_scaling="rolling_zscore",
+        feature_scaling_window=6,
+    )
+    _replay(env, df)
+
+
+def test_expanding_zscore_replay_is_bitwise_identical():
+    df = _feature_df(seed=9)
+    env = make_env(
+        df,
+        feature_columns=["f1", "f2"],
+        feature_scaling="expanding_zscore",
+    )
+    _replay(env, df)
+
+
+def test_price_only_replay_is_bitwise_identical():
+    df = _feature_df(seed=11)
+    env = make_env(df)
+    _replay(env, df)
+
+
+def test_host_scaling_twin_matches_device_scaling_bitwise():
+    rng = np.random.default_rng(0)
+    win = rng.standard_normal((5, 4)).astype(np.float32) * 100.0
+    win[0, 1] = np.nan
+    win[2, 3] = np.inf
+    mean = rng.standard_normal(4).astype(np.float32)
+    std = (np.abs(rng.standard_normal(4)) + 0.1).astype(np.float32)
+    env = make_env(_feature_df())
+    for mask, neutral in (((), False), ((False, True, False, False), True)):
+        cfg = dataclasses.replace(env.cfg, binary_mask=mask, n_features=4)
+        dev = np.asarray(scale_feature_window(win, mean, std, neutral, cfg))
+        host = scale_feature_window_host(win, mean, std, neutral, cfg)
+        assert host.dtype == dev.dtype
+        assert np.array_equal(host, dev, equal_nan=True)
+
+
+def test_unsupported_obs_blocks_are_rejected_at_boot():
+    env = make_env(_feature_df())
+    cfg = dataclasses.replace(env.cfg, stage_b_force_close_obs=True)
+    with pytest.raises(ValueError, match="stage_b_force_close_obs"):
+        BarFeaturizer(cfg, env.params)
+    from gymfx_tpu.plugins import kernels as _k
+
+    if not _k.has_obs_kernel("serve_test_obs"):
+        @_k.register_obs_kernel("serve_test_obs")
+        def _extra_obs(state, data, cfg, params):  # pragma: no cover
+            return {}
+
+    cfg = dataclasses.replace(env.cfg, obs_kernels=("serve_test_obs",))
+    with pytest.raises(ValueError, match="obs_kernels"):
+        BarFeaturizer(cfg, env.params)
+    with pytest.raises(ValueError, match="feature_scaling"):
+        BarFeaturizer(env.cfg, env.params, feature_scaling="minmax")
+
+
+def test_session_input_validation():
+    df = _feature_df()
+    env = make_env(
+        df, feature_columns=["f1", "f2", "b1"],
+        feature_binary_columns=["b1"],
+    )
+    sess = BarFeaturizer.from_environment(env).new_session()
+    with pytest.raises(ValueError, match="no bars"):
+        sess.obs()
+    with pytest.raises(ValueError, match="feature columns"):
+        sess.push(1.1)  # this config requires a raw feature row
+    with pytest.raises(ValueError, match="expected 3"):
+        sess.push(1.1, [1.0, 2.0])
+
+
+def test_host_encoder_matches_device_encoder():
+    from gymfx_tpu.train.policies import make_obs_encoder, make_obs_spec
+
+    df = _feature_df()
+    env = make_env(
+        df, feature_columns=["f1", "f2", "b1"],
+        feature_binary_columns=["b1"],
+    )
+    _state, obs = env_core.reset(env.cfg, env.params, env.data)
+    spec = make_obs_spec(obs)
+    for name in ("mlp", "transformer"):
+        dev = np.asarray(
+            make_obs_encoder(name, env.cfg.window_size, spec)(obs)
+        )
+        host = make_host_encoder(name, env.cfg.window_size, spec)(
+            {k: np.asarray(v) for k, v in obs.items()}
+        )
+        assert host.dtype == dev.dtype and host.shape == dev.shape, name
+        assert np.array_equal(host, dev, equal_nan=True), name

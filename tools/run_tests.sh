@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Canonical tier-1 test invocation (the known-good procedure, in the
+# repo instead of session notes — VERDICT.md round-5 item 8).
+#
+#   tools/run_tests.sh            # tier-1 (everything not marked slow)
+#   tools/run_tests.sh -k serve   # extra args forwarded to pytest
+#
+# Cache hygiene: tests/conftest.py points the jax persistent compile
+# cache at a FRESH per-session directory and exports it, so the main
+# process warms it for the subprocess tests (CLI roundtrips, bench
+# smokes) but no run ever deserializes another run's entries —
+# reading large vmapped programs from a stale cache corrupts the heap
+# on the CPU backend and segfaults minutes later at an unrelated
+# allocation.  If a run still dies mid-suite with "Fatal Python
+# error: Segmentation fault" during garbage collection or tracing,
+# suspect a shared/stale JAX_COMPILATION_CACHE_DIR leaking in from
+# the environment before blaming the test that happened to be running.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider "$@"
