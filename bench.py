@@ -34,6 +34,11 @@ def main() -> None:
              "per-step dispatch)",
     )
     ap.add_argument("--quick", action="store_true", help="small shapes (CI)")
+    ap.add_argument(
+        "--trace", type=str, default=None, metavar="DIR",
+        help="capture a jax.profiler trace of the timed loop into DIR "
+             "(view with tensorboard or xprof)",
+    )
     args = ap.parse_args()
     if args.quick:
         args.n_envs, args.horizon, args.iters = 256, 32, 2
@@ -70,17 +75,45 @@ def main() -> None:
         # rollover this way: 32k envs sustain 12.5M)
         ppo_minibatch_scheme="env_permute",
         window_size=32,
+        # rollout hot-path (r6): fused per-step obs kernel on TPU (plain
+        # XLA elsewhere — rollout_obs_kernel="on" falls back off-TPU) and
+        # bf16 trajectory obs storage, halving the widest collected
+        # buffer's HBM write+read traffic (docs/performance.md)
+        rollout_obs_kernel="on",
+        rollout_collect_dtype="bfloat16",
     )
     env = Environment(config)
     trainer = PPOTrainer(env, ppo_config_from(config))
 
-    from gymfx_tpu.bench_util import measure_train_many, measure_train_step, mfu
+    from gymfx_tpu.bench_util import (
+        measure_phase_split,
+        measure_train_many,
+        measure_train_step,
+        mfu,
+    )
 
     state = trainer.init_state(0)
     # always time the per-step dispatch path: it is both the K=1
     # headline and the baseline the superstep overhead is measured from
     dt1, step_flops, state, _step = measure_train_step(trainer, state, args.iters)
     per_step_single = dt1 / args.iters
+
+    # phase attribution: rollout vs update halves timed as donated-carry
+    # sub-programs off the same phase methods the fused step composes
+    # (bench_util.measure_phase_split) — proves where the cycle goes
+    rollout_ms = update_ms = None
+    split = measure_phase_split(trainer, state, args.iters)
+    if split is not None:
+        rollout_s, update_s, state = split
+        rollout_ms = rollout_s / args.iters * 1e3
+        update_ms = update_s / args.iters * 1e3
+
+    if args.trace:
+        # one traced fused step on the already-compiled executable
+        jax.profiler.start_trace(args.trace)
+        state, _m = _step(state)
+        jax.block_until_ready(state)
+        jax.profiler.stop_trace()
 
     K = max(1, args.supersteps)
     baseline_per_chip = 1_000_000 / 8  # BASELINE.json: 1M steps/s on v5p-8
@@ -119,6 +152,15 @@ def main() -> None:
                     round(overhead, 4) if overhead is not None else None
                 ),
                 "per_step_ms_single_dispatch": round(per_step_single * 1e3, 3),
+                # rollout/update phase attribution (donated-carry
+                # sub-programs; sums slightly above the fused step —
+                # read them as a ratio, not an absolute)
+                "rollout_ms": (
+                    round(rollout_ms, 3) if rollout_ms is not None else None
+                ),
+                "update_ms": (
+                    round(update_ms, 3) if update_ms is not None else None
+                ),
             }
         )
     )

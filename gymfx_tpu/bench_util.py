@@ -107,6 +107,50 @@ def measure_train_many(trainer: Any, state: Any, dispatches: int, k: int):
     return time.perf_counter() - t0, flops, state, step
 
 
+def measure_phase_split(trainer: Any, state: Any, iters: int):
+    """Phase-attributed twin of :func:`measure_train_step`: times the
+    rollout and update halves of the train step as two donated-carry
+    sub-programs compiled off the same phase methods the fused step is
+    composed from (``_rollout_phase`` / ``_update_phase``), so the split
+    is measured on real executables rather than inferred.
+
+    The sum slightly overstates the fused step (two dispatches, a
+    host sync between phases, and no cross-phase fusion), so callers
+    should report the *fraction* against the fused per-step time.
+    Returns ``(rollout_seconds, update_seconds, final_state)``, or
+    ``None`` when the trainer has no phase methods.
+    """
+    import jax
+
+    if not (hasattr(trainer, "_rollout_phase")
+            and hasattr(trainer, "_update_phase")):
+        return None
+
+    r_jit = jax.jit(trainer._rollout_phase, donate_argnums=0)
+    u_jit = jax.jit(trainer._update_phase, donate_argnums=(0, 1))
+    r_step, _ = compile_with_flops(r_jit, state)
+    if r_step is None:
+        r_step = r_jit
+    inter, rollout_out = r_step(state)
+    u_step, _ = compile_with_flops(u_jit, inter, rollout_out)
+    if u_step is None:
+        u_step = u_jit
+    state, _ = u_step(inter, rollout_out)  # warmup both phases
+    jax.block_until_ready(state)
+
+    rollout_s = update_s = 0.0
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        inter, rollout_out = r_step(state)
+        jax.block_until_ready((inter, rollout_out))
+        t1 = time.perf_counter()
+        state, _metrics = u_step(inter, rollout_out)
+        jax.block_until_ready(state)
+        update_s += time.perf_counter() - t1
+        rollout_s += t1 - t0
+    return rollout_s, update_s, state
+
+
 # Public per-chip peak dense bf16 FLOPs/sec (vendor-published specs).
 PEAK_BF16_FLOPS = {
     "v6e": 918e12,

@@ -59,6 +59,15 @@ def parse_args(argv=None):
     # dispatch / memory flags (docs/performance.md)
     parser.add_argument("--supersteps_per_dispatch", type=int)
     parser.add_argument("--stream_hbm_budget_mb", type=float)
+    parser.add_argument(
+        "--ppo_minibatch_scheme", choices=["env_permute", "sample_permute"]
+    )
+    parser.add_argument(
+        "--rollout_obs_kernel", choices=["off", "on", "interpret"]
+    )
+    parser.add_argument(
+        "--rollout_collect_dtype", choices=["float32", "bfloat16"]
+    )
 
     # serving flags (docs/serving.md); buckets as JSON, e.g. "[1,8,64]"
     parser.add_argument("--serve_buckets", type=str)

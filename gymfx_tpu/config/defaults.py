@@ -100,6 +100,26 @@ DEFAULT_VALUES = {
     # the resident MarketData would exceed this many MiB (None = always
     # resident); rollout-only — trainers need the full history resident
     "stream_hbm_budget_mb": None,
+    # PPO minibatch source: env-permuted trajectory minibatches
+    # (contiguous update-phase DMA; measured 12.4M vs 8.3M steps/s at
+    # 8192 envs with identical held-out learning — the round-5 fix,
+    # examples/results/minibatch_scheme_parity.json) vs the classic
+    # flattened sample permutation.  env_permute needs num_envs
+    # divisible by ppo_minibatches; configs where that cannot hold
+    # (num_envs < ppo_minibatches, e.g. the single-env inference
+    # default) degrade to sample_permute with a warning at the
+    # from-config entry points (train/common.resolve_minibatch_scheme)
+    "ppo_minibatch_scheme": "env_permute",  # env_permute | sample_permute
+    # per-step fused feature scaling in the rollout (pallas kernel,
+    # ops/window_zscore.fused_step_obs): off = plain XLA (the bitwise
+    # oracle), on = pallas on TPU / XLA fallback elsewhere, interpret =
+    # pallas interpret mode anywhere (CPU parity tests)
+    "rollout_obs_kernel": "off",
+    # storage dtype for the COLLECTED trajectory obs (the widest rollout
+    # buffers): bfloat16 halves trajectory write+read HBM traffic;
+    # actions/log-probs/values always stay f32 so PPO ratio numerics
+    # are untouched (quality-parity gate: docs/performance.md)
+    "rollout_collect_dtype": "float32",  # float32 | bfloat16
     # live-path retry/backoff + circuit breaker (oanda_broker plugin)
     "live_retry_max_attempts": 4,
     "live_retry_base_delay": 0.25,

@@ -89,6 +89,32 @@ def scale_feature_window_host(win, mean, std, neutral, cfg: "EnvConfig"):
     return scaled.astype(xp.float32)
 
 
+def _scaled_features(win, mean, std, neutral, cfg: "EnvConfig"):
+    """Rollout feature-scaling dispatch (`rollout_obs_kernel` knob,
+    docs/performance.md): "on" routes through the fused pallas per-step
+    kernel on TPU and falls back to the plain-XLA oracle elsewhere;
+    "interpret" forces pallas interpret mode on any backend (the CPU
+    parity tests); "off" is the plain-XLA path everywhere.  All three
+    are bitwise-identical by construction (the kernel body reproduces
+    :func:`scale_feature_window` op for op; tests/test_ops.py +
+    tests/test_rollout_obs_kernel.py pin it)."""
+    mode = getattr(cfg, "rollout_obs_kernel", "off")
+    if mode != "off":
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+        if mode == "interpret" or on_tpu:
+            from gymfx_tpu.ops.window_zscore import fused_step_obs
+
+            return fused_step_obs(
+                win, mean, std, neutral,
+                binary_mask=cfg.binary_mask, clip=cfg.feature_clip,
+                interpret=(mode == "interpret") or not on_tpu,
+            )
+        # "on" off-TPU: the plain-XLA fallback below
+    return scale_feature_window(win, mean, std, neutral, cfg)
+
+
 def build_obs(
     state: EnvState, data: MarketData, cfg: EnvConfig, params: EnvParams
 ) -> Dict[str, Any]:
@@ -103,7 +129,7 @@ def build_obs(
         mean = data.feat_mean[step - r0]
         std = data.feat_std[step - r0]
         neutral = data.feat_neutral[step - r0]
-        obs["features"] = scale_feature_window(win, mean, std, neutral, cfg)
+        obs["features"] = _scaled_features(win, mean, std, neutral, cfg)
 
     price = data.close[state.t - r0]
     prices = None

@@ -97,8 +97,19 @@ DRIVERS = {
 }
 
 
-def _make_scan_body(cfg, params, data, driver, collect, offset):
-    """The one scan body shared by rollout and rollout_chunked."""
+def _make_scan_body(cfg, params, data, driver, collect, offset,
+                    collect_dtype=None):
+    """The one scan body shared by rollout and rollout_chunked.
+
+    ``collect_dtype`` (None = keep f32) narrows only the float
+    *diagnostic* streams — reward and the pending/bracket price
+    traces — to cut collected-buffer HBM traffic on long episodes.
+    equity_delta/equity stay full precision (metrics derive equity
+    from the delta in f64), and done/action/position/counters are
+    integral and untouched.
+    """
+    _cd = (lambda x: x) if collect_dtype is None else (
+        lambda x: x.astype(collect_dtype))
 
     def body(carry, i):
         state, obs, rng, dcarry = carry
@@ -112,7 +123,7 @@ def _make_scan_body(cfg, params, data, driver, collect, offset):
                 # so metrics must derive equity from the delta in f64.
                 "equity_delta": state.equity_delta,
                 "equity": params.initial_cash + state.equity_delta,
-                "reward": reward,
+                "reward": _cd(reward),
                 "done": done,
                 "action": jnp.asarray(action, dtype=jnp.int32),
                 "position": jnp.sign(state.pos).astype(jnp.int32),
@@ -123,17 +134,17 @@ def _make_scan_body(cfg, params, data, driver, collect, offset):
                 # cross-check re-executes, incl. bracket prices
                 # (simulation/crosscheck.py)
                 "pending_active": state.pending_active,
-                "pending_target": state.pending_target,
-                "pending_sl": state.pending_sl,
-                "pending_tp": state.pending_tp,
+                "pending_target": _cd(state.pending_target),
+                "pending_sl": _cd(state.pending_sl),
+                "pending_tp": _cd(state.pending_tp),
                 "pos_units": state.pos,
                 # the ACTUAL armed bracket levels and the venue-denial
                 # counter after this step: the crosscheck builds each
                 # bar's execution path from these instead of inferring
                 # them from order history (stale levels / denied fills
                 # would otherwise poison later bars' paths)
-                "bracket_sl": state.bracket_sl,
-                "bracket_tp": state.bracket_tp,
+                "bracket_sl": _cd(state.bracket_sl),
+                "bracket_tp": _cd(state.bracket_tp),
                 "order_denied": state.exec_diag[
                     EXEC_DIAG_INDEX["order_denied_min_quantity"]
                 ],
@@ -150,7 +161,8 @@ def _make_scan_body(cfg, params, data, driver, collect, offset):
     return body
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "driver", "collect"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "driver", "collect",
+                                   "collect_dtype"))
 def rollout(
     cfg: EnvConfig,
     params: EnvParams,
@@ -160,6 +172,7 @@ def rollout(
     rng: Any,
     collect: bool = True,
     driver_carry: Any = None,
+    collect_dtype: Any = None,
 ):
     """Run one episode for ``steps`` env steps (frozen after termination).
 
@@ -174,7 +187,8 @@ def rollout(
     """
     state, obs = env_core.reset(cfg, params, data)
     init_carry = driver.init() if driver_carry is None else driver_carry
-    body = _make_scan_body(cfg, params, data, driver, collect, 0)
+    body = _make_scan_body(cfg, params, data, driver, collect, 0,
+                           collect_dtype)
     (state, obs, rng, _), outputs = jax.lax.scan(
         body, (state, obs, rng, init_carry), jnp.arange(steps)
     )
@@ -190,14 +204,16 @@ def episode_step_count(outputs) -> Any:
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "chunk", "driver", "collect")
+    jax.jit,
+    static_argnames=("cfg", "chunk", "driver", "collect", "collect_dtype"),
 )
 def _rollout_chunk(
     cfg, params, data, driver, chunk, state, obs, rng, dcarry, offset,
-    collect=True,
+    collect=True, collect_dtype=None,
 ):
     """One fixed-size compiled segment of an episode (see rollout_chunked)."""
-    body = _make_scan_body(cfg, params, data, driver, collect, offset)
+    body = _make_scan_body(cfg, params, data, driver, collect, offset,
+                           collect_dtype)
     (state, obs, rng, dcarry), outputs = jax.lax.scan(
         body, (state, obs, rng, dcarry), jnp.arange(chunk)
     )
@@ -214,6 +230,7 @@ def rollout_chunked(
     collect: bool = True,
     driver_carry: Any = None,
     chunk_size: int = 64,
+    collect_dtype: Any = None,
 ):
     """Episode rollout as a host loop over fixed-size compiled segments.
 
@@ -234,7 +251,7 @@ def rollout_chunked(
         this = min(chunk_size, steps - done_steps)
         state, obs, rng, dcarry, out = _rollout_chunk(
             cfg, params, data, driver, this, state, obs, rng, dcarry,
-            jnp.asarray(done_steps, jnp.int32), collect,
+            jnp.asarray(done_steps, jnp.int32), collect, collect_dtype,
         )
         if collect:
             pieces.append(out)
@@ -256,6 +273,7 @@ def rollout_streamed(
     collect: bool = True,
     driver_carry: Any = None,
     chunk_size: int = 64,
+    collect_dtype: Any = None,
 ):
     """Episode rollout over a :class:`~gymfx_tpu.data.feed.BarStreamer`.
 
@@ -292,7 +310,7 @@ def rollout_streamed(
             this = min(chunk_size, end - done_steps)
             state, obs, rng, dcarry, out = _rollout_chunk(
                 cfg, params, shard, driver, this, state, obs, rng, dcarry,
-                jnp.asarray(done_steps, jnp.int32), collect,
+                jnp.asarray(done_steps, jnp.int32), collect, collect_dtype,
             )
             if collect:
                 pieces.append(out)

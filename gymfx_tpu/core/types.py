@@ -99,6 +99,11 @@ class EnvConfig:
 
     reward: str = "pnl_reward"               # pnl_reward | sharpe_reward | dd_penalized_reward | registered kernel
     obs_kernels: Tuple[str, ...] = ()        # registered extra obs blocks
+    # per-step fused feature scaling (ops/window_zscore.fused_step_obs):
+    # "on" = pallas on TPU, plain XLA elsewhere; "interpret" = pallas
+    # interpret mode on any backend (CPU parity tests); "off" = plain
+    # XLA everywhere (the bitwise oracle)
+    rollout_obs_kernel: str = "off"          # off | on | interpret
     sharpe_window: int = 64
     stage_b_force_close_reward_penalty: bool = False
 
@@ -143,6 +148,11 @@ class EnvConfig:
         for name in self.obs_kernels:
             if not _k.has_obs_kernel(name):
                 raise ValueError(f"unknown obs kernel {name!r}")
+        if self.rollout_obs_kernel not in ("off", "on", "interpret"):
+            raise ValueError(
+                f"rollout_obs_kernel must be off|on|interpret, got "
+                f"{self.rollout_obs_kernel!r}"
+            )
         if self.margin_model not in ("standard", "leveraged"):
             raise ValueError(f"unknown margin_model {self.margin_model!r}")
         if self.intrabar_collision_policy not in ("worst_case", "adaptive", "ohlc"):
@@ -391,6 +401,7 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         atr_period=int(config.get("atr_period", 14)),
         reward=str(config.get("reward_plugin", "pnl_reward")),
         obs_kernels=_obs_kernel_names(config.get("obs_plugins")),
+        rollout_obs_kernel=str(config.get("rollout_obs_kernel", "off")).lower(),
         sharpe_window=int(config.get("window", config.get("sharpe_window", 64))),
         stage_b_force_close_reward_penalty=bool(
             config.get("stage_b_force_close_reward_penalty", False)

@@ -22,11 +22,15 @@ Granularity matters twice here:
 
 Numerics run in float32 inside the kernel regardless of the policy
 dtype, like XLA's f32 matmul accumulation on bf16 inputs.
-Differentiable: the backward recomputes through the plain-XLA twin
-(``parallel.ring_attention.full_attention``) and takes its gradient —
-the standard flash-attention recompute trade (no residual score
-tensor, extra forward FLOPs on the rarer update pass; the rollout /
-eval hot path is forward-only).
+Differentiable: the backward is a fused Pallas kernel too
+(``_bwd_kernel``) — it saves no score tensor, recomputes the softmax
+probabilities from q/k inside VMEM (the standard flash-attention
+recompute trade: extra forward FLOPs on the rarer update pass, zero
+HBM score traffic), then forms dV, dS, dQ, dK in the same
+env-blocked single pass.  The plain-XLA twin
+(``parallel.ring_attention.full_attention``) is the parity oracle
+for BOTH directions (tests/test_ops.py), not part of the compiled
+gradient.
 
 Falls back to pallas interpret mode off-TPU, so tests run on CPU; the
 plain-XLA twin remains the parity oracle and the >1024-window fallback.
@@ -259,8 +263,9 @@ def fused_window_attention(q, k, v, *, causal: bool = False,
                            interpret: bool | None = None):
     """Exact attention for (..., W, H, D) q/k/v with the score blocks
     kept in VMEM.  Any leading batch dims (flattened into the kernel's
-    env-block grid).  Differentiable (XLA-recompute backward).  Returns
-    (..., W, H, D) in the input dtype."""
+    env-block grid).  Differentiable (fused Pallas backward that
+    recomputes the probabilities in VMEM — see module docstring).
+    Returns (..., W, H, D) in the input dtype."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     *batch, s, h, d = q.shape

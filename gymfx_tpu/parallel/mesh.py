@@ -19,6 +19,30 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+try:  # public alias since jax 0.5
+    shard_map = jax.shard_map
+except AttributeError:  # older jax: only the experimental module exists
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, **kwargs):
+        # old-jax replication checking predates the varying-axis types
+        # our kernels annotate with pcast_varying (a no-op there), so it
+        # would reject loop carries that flip replicated -> varying;
+        # disable the static check, the computation is unchanged
+        return _experimental_shard_map(f, check_rep=False, **kwargs)
+
+
+def pcast_varying(x, axis: str):
+    """``jax.lax.pcast(x, axis, to="varying")`` where available: marks a
+    replicated value as device-varying over ``axis`` so e.g. fori_loop
+    carry types match after a ``ppermute``.  Old jax has no varying-axis
+    type system — the annotation is unnecessary and the value is
+    returned unchanged."""
+    try:
+        return jax.lax.pcast(x, axis, to="varying")
+    except AttributeError:
+        return x
+
 
 def honor_jax_platforms_env() -> None:
     """Make ``JAX_PLATFORMS=cpu`` win even when a sitecustomize

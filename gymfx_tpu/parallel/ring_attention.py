@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gymfx_tpu.parallel.mesh import pcast_varying, shard_map
+
 
 def _block_attention(q, k, v, m, l, acc, scale, mask):
     """One online-softmax accumulation step (leading batch dims allowed).
@@ -80,10 +82,10 @@ def ring_attention_inner(
 
     # mark the accumulators as device-varying over the ring axis so the
     # fori_loop carry type matches after the first iteration
-    m0 = jax.lax.pcast(
-        jnp.full((*batch, h, sb), -jnp.inf, q_blk.dtype), axis, to="varying"
+    m0 = pcast_varying(
+        jnp.full((*batch, h, sb), -jnp.inf, q_blk.dtype), axis
     )
-    l0 = jax.lax.pcast(jnp.zeros((*batch, h, sb), q_blk.dtype), axis, to="varying")
+    l0 = pcast_varying(jnp.zeros((*batch, h, sb), q_blk.dtype), axis)
     acc0 = jnp.zeros_like(q_blk)
     _, _, m, l, acc = jax.lax.fori_loop(
         0, n_shards, body, (k_blk, v_blk, m0, l0, acc0)
@@ -111,7 +113,7 @@ def ring_attention(
         )
 
     spec = P(axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     return fn(q, k, v)

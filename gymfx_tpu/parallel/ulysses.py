@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gymfx_tpu.parallel.mesh import shard_map
 from gymfx_tpu.parallel.ring_attention import full_attention
 
 
@@ -89,7 +90,7 @@ def ulysses_attention(
         )
 
     spec = P(axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     return fn(q, k, v)

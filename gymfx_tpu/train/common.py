@@ -202,6 +202,11 @@ def eval_checkpointed_policy(
 
     meta = read_metadata(str(ckpt_dir))
     config = dict(config)
+    # the minibatch scheme shapes only the UPDATE pass, which never runs
+    # in inference — pin the scheme that is valid for ANY env count so
+    # the env_permute training default (config/defaults.py) cannot
+    # reject a single-env eval trainer at construction
+    config["ppo_minibatch_scheme"] = "sample_permute"
     if resolve_policy is not None:
         resolve_policy(meta, config)
     train_env, eval_env = build_envs(config)
@@ -255,6 +260,30 @@ def validate_minibatch_scheme(scheme: str, n_envs: int, minibatches: int,
                 "sample",
                 stacklevel=2,
             )
+
+
+def resolve_minibatch_scheme(config, n_envs: int, minibatches: int) -> None:
+    """From-config entry-point resolution of the env_permute default
+    (config/defaults.py): when the requested scheme is env_permute but
+    num_envs < ppo_minibatches — a shape where whole-trajectory
+    minibatches CANNOT exist (e.g. the single-env inference default) —
+    degrade to sample_permute with a warning instead of refusing to
+    train.  Fixable mismatches (num_envs >= minibatches but not
+    divisible) still raise at trainer construction
+    (:func:`validate_minibatch_scheme`): those have a right answer the
+    user should pick.  Mutates ``config`` in place."""
+    scheme = str(config.get("ppo_minibatch_scheme", "env_permute"))
+    if scheme == "env_permute" and int(n_envs) < int(minibatches):
+        import warnings
+
+        warnings.warn(
+            f"ppo_minibatch_scheme=env_permute needs num_envs "
+            f"({n_envs}) >= ppo_minibatches ({minibatches}); falling "
+            "back to sample_permute for this run — raise num_envs to a "
+            "multiple of ppo_minibatches to use trajectory minibatches",
+            stacklevel=2,
+        )
+        config["ppo_minibatch_scheme"] = "sample_permute"
 
 
 def minibatch_plan(fields, *, scheme: str, n_envs: int, horizon: int,

@@ -58,10 +58,21 @@ class ImpalaConfig(NamedTuple):
     policy: str = "lstm"
     policy_dtype: Any = jnp.float32
     policy_kwargs: Tuple[Tuple[str, Any], ...] = ()
+    # trajectory-obs storage dtype (resolved like PPO's:
+    # train/ppo.resolve_collect_dtype — never wider than policy_dtype)
+    collect_dtype: Any = jnp.float32
     # non-finite guard (resilience/guards.py): skip the whole learner
     # update when loss/grads go non-finite and quarantine-reset envs
     # whose segment produced NaN/inf (see train/ppo.py)
     nonfinite_guard: bool = True
+
+
+def _resolve_collect_dtype(config, policy_dtype):
+    # ONE definition of the collect-dtype resolution (train/ppo.py);
+    # imported lazily to keep this module import-light
+    from gymfx_tpu.train.ppo import resolve_collect_dtype
+
+    return resolve_collect_dtype(config, policy_dtype)
 
 
 def impala_config_from(config: Dict[str, Any]) -> ImpalaConfig:
@@ -85,6 +96,7 @@ def impala_config_from(config: Dict[str, Any]) -> ImpalaConfig:
             (k, tuple(v) if isinstance(v, list) else v)
             for k, v in (config.get("policy_kwargs") or {}).items()
         ),
+        collect_dtype=_resolve_collect_dtype(config, dt),
         nonfinite_guard=bool(config.get("nonfinite_guard", True)),
     )
 
@@ -231,10 +243,11 @@ class ImpalaTrainer:
             obs_vec2 = masked_reset(done, reset_vec, obs_vec2)
             pcarry2 = masked_reset(done, carry0, pcarry2)
             out = dict(
-                # obs stored in the policy compute dtype (bit-identical
-                # policy inputs — every policy casts at entry; halves
-                # the learner-pass HBM buffer under bf16, train/ppo.py)
-                obs=obs_vec.astype(self.icfg.policy_dtype),
+                # obs stored in the resolved collect dtype (never wider
+                # than the policy's entry cast — see
+                # train/ppo.resolve_collect_dtype); halves the
+                # learner-pass HBM buffer under bf16
+                obs=obs_vec.astype(self.icfg.collect_dtype),
                 action=action, mu_logp=logp,
                 reward=reward.astype(jnp.float32), done=done,
             )
@@ -320,13 +333,33 @@ class ImpalaTrainer:
             mean_rho=rhos.mean(),
         )
 
-    def _train_step_impl(self, state: ImpalaState):
+    def _rollout_phase(self, state: ImpalaState):
+        """Phase 1: collect one unroll with the (stale) actor params.
+        ``rollout_out`` carries the PRE-rollout policy carry alongside
+        the segment: the learner replay unrolls the segment from the
+        carry the actors STARTED from, not the one they ended with.
+        ``_train_step_impl`` is exactly the composition of this and
+        :meth:`_update_phase` (bench.py phase attribution; the
+        superstep bit-identity tests pin the factoring)."""
         env_states, obs_vec, pcarry, rng, traj = self._rollout(
             state.actor_params, state.env_states, state.obs_vec,
             state.policy_carry, state.rng,
         )
+        inter = state._replace(
+            env_states=env_states, obs_vec=obs_vec, policy_carry=pcarry,
+            rng=rng,
+        )
+        return inter, (traj, state.policy_carry)
+
+    def _update_phase(self, state: ImpalaState, rollout_out):
+        """Phase 2: one V-trace learner update on the collected segment
+        (+ guard bookkeeping and the staleness-sync counter)."""
+        traj, init_carry = rollout_out
+        env_states, obs_vec, pcarry, rng = (
+            state.env_states, state.obs_vec, state.policy_carry, state.rng
+        )
         (loss, aux), grads = jax.value_and_grad(self._loss, has_aux=True)(
-            state.learner_params, traj, state.policy_carry, obs_vec
+            state.learner_params, traj, init_carry, obs_vec
         )
         updates, new_opt_state = self.optimizer.update(
             grads, state.opt_state, state.learner_params
@@ -395,6 +428,10 @@ class ImpalaTrainer:
             ),
             metrics,
         )
+
+    def _train_step_impl(self, state: ImpalaState):
+        inter, rollout_out = self._rollout_phase(state)
+        return self._update_phase(inter, rollout_out)
 
     # ------------------------------------------------------------------
     def train_step(self, state: ImpalaState):

@@ -171,6 +171,20 @@ class Optimizer:
             )
             pop = np.clip(np.concatenate([elites, mutations], axis=0), lo, hi)
 
+        # a winner pinned to a schema bound (e.g. the k_tp=1.5 floor)
+        # says the optimum may lie OUTSIDE the searched box — the bound
+        # is the binding constraint, not a free optimum, and the
+        # evidence tooling must surface that instead of presenting the
+        # clipped value as converged (tools/optimize_evidence.py)
+        boundary: Dict[str, str] = {}
+        for i, (name, l, h) in enumerate(self.schema):
+            v = float(best_vals[i])
+            tol = 1e-3 * max(h - l, 1e-12)
+            if v <= l + tol:
+                boundary[name] = "low"
+            elif v >= h - tol:
+                boundary[name] = "high"
+
         return {
             "mode": "optimization",
             "schema": [
@@ -185,6 +199,7 @@ class Optimizer:
                 for i, (name, _, _) in enumerate(self.schema)
             },
             "best_rap": best_fit,
+            "boundary_clipped": boundary,
             "history": history,
             "selection_signal": bool(any(h["rap_std"] > 0.0 for h in history)),
             "wall_seconds": time.perf_counter() - t0,
@@ -389,6 +404,15 @@ def optimize_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
             best_period, best = period, res
 
     best["best_params"] = {**best["best_params"], "atr_period": best_period}
+    # the outer sweep has bounds too: a winner at a grid endpoint is as
+    # boundary-clipped as an inner-GA winner at a schema bound
+    if len(grid) > 1:
+        bc = dict(best.get("boundary_clipped") or {})
+        if best_period == grid[0]:
+            bc["atr_period"] = "low"
+        elif best_period == grid[-1]:
+            bc["atr_period"] = "high"
+        best["boundary_clipped"] = bc
     best["schema"].append(
         {
             "name": "atr_period",

@@ -261,6 +261,9 @@ def make_portfolio_pbt(config: Dict[str, Any], pbt: PBTConfig,
 
     if env is None:
         env = PortfolioEnvironment(config)
+    from gymfx_tpu.train.common import resolve_minibatch_scheme
+    resolve_minibatch_scheme(config, int(config.get("num_envs", 64) or 64),
+                             int(config.get("ppo_minibatches", 4)))
     pcfg = PortfolioPPOConfig(
         n_envs=int(config.get("num_envs", 64) or 64),
         horizon=int(config.get("ppo_horizon", 64)),
@@ -269,7 +272,7 @@ def make_portfolio_pbt(config: Dict[str, Any], pbt: PBTConfig,
         lr=float(config.get("learning_rate", 3e-4)),
         policy=str(config.get("policy") or "mlp"),
         minibatch_scheme=str(
-            config.get("ppo_minibatch_scheme", "sample_permute")
+            config.get("ppo_minibatch_scheme", "env_permute")
         ),
     )
     return PBTTrainer(env, None, pbt, core=_PBTPortfolioCore(env, pcfg),
@@ -332,6 +335,12 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.train.common import build_train_eval_envs
 
     env, eval_env = build_train_eval_envs(config)
+    from gymfx_tpu.train.common import resolve_minibatch_scheme
+
+    resolve_minibatch_scheme(
+        config, int(config.get("num_envs", 256) or 256),
+        int(config.get("ppo_minibatches", 4)),
+    )
     pcfg = ppo_config_from(config)
     pbt = _pbt_config_from(config)
     trainer = PBTTrainer(env, pcfg, pbt, mesh=mesh)

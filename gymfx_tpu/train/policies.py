@@ -342,7 +342,9 @@ def seq_sharded_forward(policy, params, tokens, mesh, axis: str = "seq"):
     def f(tok_blk):
         return sharded.apply(params, tok_blk)
 
-    fn = jax.shard_map(
+    from gymfx_tpu.parallel.mesh import shard_map
+
+    fn = shard_map(
         f, mesh=mesh, in_specs=(tok_spec,),
         out_specs=(out_spec, out_spec),
     )

@@ -123,6 +123,12 @@ class PortfolioPPOConfig(NamedTuple):
     # sample_permute | env_permute — the same schemes as the single-pair
     # trainer (train/ppo.py PPOConfig.minibatch_scheme)
     minibatch_scheme: str = "sample_permute"
+    # policy compute dtype (heads stay f32 like the single-pair policies)
+    policy_dtype: Any = jnp.float32
+    # trajectory-obs storage dtype — THE widest buffers in the repo
+    # ((T, N, window*pairs*features) portfolio obs); resolved like the
+    # single-pair trainers (train/ppo.resolve_collect_dtype)
+    collect_dtype: Any = jnp.float32
 
 
 class PortfolioTrainState(NamedTuple):
@@ -168,15 +174,20 @@ class PortfolioPPOTrainer:
         )
         n_pairs = env.cfg.n_pairs
         if pcfg.policy == "transformer":
-            self.policy = PortfolioTransformerPolicy(n_pairs=n_pairs)
+            self.policy = PortfolioTransformerPolicy(
+                n_pairs=n_pairs, dtype=pcfg.policy_dtype
+            )
         elif pcfg.policy in ("transformer_ring", "transformer_ulysses"):
             self.policy = PortfolioRingTransformerPolicy(
                 n_pairs=n_pairs, window=env.cfg.window_size,
+                dtype=pcfg.policy_dtype,
                 sp_backend="ulysses" if pcfg.policy == "transformer_ulysses"
                 else "ring",
             )
         elif pcfg.policy == "mlp":
-            self.policy = PortfolioMLPPolicy(n_pairs=n_pairs)
+            self.policy = PortfolioMLPPolicy(
+                n_pairs=n_pairs, dtype=pcfg.policy_dtype
+            )
         else:
             raise ValueError(
                 f"portfolio trainer supports policy "
@@ -258,8 +269,15 @@ class PortfolioPPOTrainer:
             obs_vec2 = vencode(obs2)
             env_states2 = masked_reset(done, reset_state, env_states2)
             obs_vec2 = masked_reset(done, reset_vec, obs_vec2)
-            out = dict(obs=obs_vec, action=actions, logp=logp, value=value,
-                       reward=reward.astype(jnp.float32), done=done)
+            out = dict(
+                # the (T, N, window*pairs*features) obs block is the
+                # repo's widest trajectory buffer — stored in the
+                # resolved collect dtype (train/ppo.resolve_collect_dtype;
+                # bf16 halves its write+read HBM traffic); actions/
+                # log-probs/values stay f32 so ratio numerics hold
+                obs=obs_vec.astype(self.pcfg.collect_dtype),
+                action=actions, logp=logp, value=value,
+                reward=reward.astype(jnp.float32), done=done)
             return (env_states2, obs_vec2, rng), out
 
         (env_states, obs_vec, rng), traj = jax.lax.scan(
@@ -316,11 +334,23 @@ class PortfolioPPOTrainer:
         overrides with per-member traced values (train/pbt.py)."""
         return self.pcfg.clip_eps, self.pcfg.ent_coef
 
-    def _train_step_impl(self, state: PortfolioTrainState):
-        pcfg = self.pcfg
+    def _rollout_phase(self, state: PortfolioTrainState):
+        """Phase 1 of the train step (see train/ppo.py _rollout_phase:
+        the split exists for bench phase attribution and is pinned to
+        compose bitwise into ``_train_step_impl``)."""
         env_states, obs_vec, rng, traj, bootstrap = self._rollout(
             state.params, state.env_states, state.obs_vec, state.rng
         )
+        inter = PortfolioTrainState(
+            state.params, state.opt_state, env_states, obs_vec, rng
+        )
+        return inter, (traj, bootstrap)
+
+    def _update_phase(self, state: PortfolioTrainState, rollout_out):
+        """Phase 2: GAE + minibatched epochs on a collected trajectory."""
+        pcfg = self.pcfg
+        traj, bootstrap = rollout_out
+        env_states, obs_vec, rng = state.env_states, state.obs_vec, state.rng
         advs, returns = self._gae(traj, bootstrap)
         fields = {
             "obs": traj["obs"],
@@ -371,6 +401,10 @@ class PortfolioPPOTrainer:
             mean_reward=traj["reward"].mean(),
         )
         return PortfolioTrainState(params, opt_state, env_states, obs_vec, rng), metrics
+
+    def _train_step_impl(self, state: PortfolioTrainState):
+        inter, rollout_out = self._rollout_phase(state)
+        return self._update_phase(inter, rollout_out)
 
     def train_step(self, state):
         return self._train_step(state)
@@ -513,16 +547,28 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     )
 
     env, eval_env = build_portfolio_train_eval_envs(config)
+    from gymfx_tpu.train.common import resolve_minibatch_scheme
+    from gymfx_tpu.train.ppo import resolve_collect_dtype
+
+    n_envs = int(config.get("num_envs", 64) or 64)
+    resolve_minibatch_scheme(
+        config, n_envs, int(config.get("ppo_minibatches", 4))
+    )
+    pdt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        str(config.get("policy_dtype", "float32"))
+    ]
     pcfg = PortfolioPPOConfig(
-        n_envs=int(config.get("num_envs", 64) or 64),
+        n_envs=n_envs,
         horizon=int(config.get("ppo_horizon", 64)),
         epochs=int(config.get("ppo_epochs", 2)),
         minibatches=int(config.get("ppo_minibatches", 4)),
         lr=float(config.get("learning_rate", 3e-4)),
         policy=str(config.get("policy") or "mlp"),
         minibatch_scheme=str(
-            config.get("ppo_minibatch_scheme", "sample_permute")
+            config.get("ppo_minibatch_scheme", "env_permute")
         ),
+        policy_dtype=pdt,
+        collect_dtype=resolve_collect_dtype(config, pdt),
     )
     from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
 

@@ -59,15 +59,40 @@ class PPOConfig(NamedTuple):
     #   classic PPO treatment; a 2M-row random HBM gather at 32k envs).
     # env_permute: permute ENVS, each minibatch holding whole (T, ...)
     #   trajectories — contiguous large-granularity DMA, the standard
-    #   recurrent-PPO sequence minibatching; recommended for >=16k-env
-    #   batches where the sample gather goes HBM-bound (VERDICT r4 #4).
+    #   recurrent-PPO sequence minibatching; the product default since
+    #   round 6 (held-out parity evidence:
+    #   examples/results/minibatch_scheme_parity.json).
     minibatch_scheme: str = "sample_permute"
+    # storage dtype for the collected trajectory obs — the (T, N,
+    # obs_dim) buffer is the rollout's widest write and the update's
+    # widest read.  Resolved in ppo_config_from to the NARROWER of this
+    # and policy_dtype (storing wider than the policy's entry cast is
+    # pure HBM waste); bf16 with a f32 policy is the lossy opt-in
+    # (quality-parity gate: docs/performance.md).  Actions, log-probs,
+    # values, advantages stay f32 — PPO ratio numerics untouched.
+    collect_dtype: Any = jnp.float32
     # non-finite guard (resilience/guards.py): skip any minibatch update
     # whose loss or grads are non-finite (params/opt-state keep the
     # last-good values bit-for-bit) and quarantine-reset envs whose
     # rollout produced NaN/inf — one poisoned feed bar no longer
     # corrupts the train state irrecoverably
     nonfinite_guard: bool = True
+
+
+def resolve_collect_dtype(config: Dict[str, Any], policy_dtype) -> Any:
+    """Trajectory-obs storage dtype: the narrower of
+    ``rollout_collect_dtype`` and the policy compute dtype.  Every
+    policy casts its input to its compute dtype at entry, so storing
+    wider than that cast is pure HBM waste (bf16 policies keep the
+    historical bf16 storage under the f32 default), while
+    ``rollout_collect_dtype: bfloat16`` with a f32 policy is the lossy
+    opt-in documented in docs/performance.md."""
+    cd = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        str(config.get("rollout_collect_dtype", "float32"))
+    ]
+    if policy_dtype == jnp.bfloat16 or cd == jnp.bfloat16:
+        return jnp.bfloat16
+    return cd
 
 
 def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
@@ -93,8 +118,9 @@ def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
             for k, v in (config.get("policy_kwargs") or {}).items()
         ),
         minibatch_scheme=str(
-            config.get("ppo_minibatch_scheme", "sample_permute")
+            config.get("ppo_minibatch_scheme", "env_permute")
         ),
+        collect_dtype=resolve_collect_dtype(config, dt),
         nonfinite_guard=bool(config.get("nonfinite_guard", True)),
     )
 
@@ -283,12 +309,12 @@ class PPOTrainer:
             obs_vec2 = masked_reset(done, reset_vec, obs_vec2)
             pcarry2 = masked_reset(done, carry0, pcarry2)
             out = dict(
-                # store obs in the policy's compute dtype: every policy
-                # casts its input to that dtype at entry, so the replay
-                # sees bit-identical inputs while the (T*N, obs_dim)
-                # minibatch buffer (the update's HBM hot spot) halves
+                # store obs in the resolved collect dtype (never wider
+                # than the policy's entry cast — resolve_collect_dtype):
+                # the (T*N, obs_dim) buffer is the rollout's widest
+                # write and the update's widest read, and it halves
                 # under bf16
-                obs=obs_vec.astype(self.pcfg.policy_dtype),
+                obs=obs_vec.astype(self.pcfg.collect_dtype),
                 action=action, logp=logp, value=value,
                 reward=reward.astype(jnp.float32), done=done,
                 # the carry that ENTERED this step — replayed during the
@@ -365,11 +391,30 @@ class PPOTrainer:
         them independently (train/pbt.py)."""
         return self.pcfg.clip_eps, self.pcfg.ent_coef
 
-    def _train_step_impl(self, state: TrainState):
-        pcfg = self.pcfg
+    def _rollout_phase(self, state: TrainState):
+        """Phase 1 of the train step: collect one horizon of experience.
+        Returns the post-rollout carry state (params/opt untouched) and
+        the rollout products the update consumes.  ``_train_step_impl``
+        is EXACTLY the composition of this and :meth:`_update_phase` —
+        the split exists so bench.py can time each phase off its own
+        donated executable (rollout_ms / update_ms), and the superstep
+        bit-identity tests (tests/test_superstep.py) pin the factoring."""
         env_states, obs_vec, pcarry_end, rng, traj, last_value = self._rollout(
             state.params, state.env_states, state.obs_vec, state.policy_carry,
             state.rng,
+        )
+        inter = TrainState(
+            state.params, state.opt_state, env_states, obs_vec, pcarry_end, rng
+        )
+        return inter, (traj, last_value)
+
+    def _update_phase(self, state: TrainState, rollout_out):
+        """Phase 2 of the train step: GAE + minibatched epochs + guard
+        bookkeeping on an already-collected trajectory."""
+        pcfg = self.pcfg
+        traj, last_value = rollout_out
+        env_states, obs_vec, pcarry_end, rng = (
+            state.env_states, state.obs_vec, state.policy_carry, state.rng
         )
         advs, returns = self._gae(traj, last_value)
 
@@ -500,6 +545,10 @@ class PPOTrainer:
             params, opt_state, env_states, obs_vec, pcarry_end, rng
         )
         return new_state, metrics
+
+    def _train_step_impl(self, state: TrainState):
+        inter, rollout_out = self._rollout_phase(state)
+        return self._update_phase(inter, rollout_out)
 
     # ------------------------------------------------------------------
     def train_step(self, state: TrainState):
@@ -705,6 +754,12 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     profile = parse_fault_profile(config.get("fault_profile"))
     if profile["nan_bars"] or profile["inf_bars"]:
         env.data = apply_fault_profile_to_market_data(env.data, profile)
+    from gymfx_tpu.train.common import resolve_minibatch_scheme
+
+    resolve_minibatch_scheme(
+        config, int(config.get("num_envs", 256) or 256),
+        int(config.get("ppo_minibatches", 4)),
+    )
     pcfg = ppo_config_from(config)
     mesh = mesh_from_config(config)
     validate_batch_axis(mesh, pcfg.n_envs, "num_envs")

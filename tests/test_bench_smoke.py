@@ -31,3 +31,8 @@ def test_bench_quick_prints_single_json_line_contract():
     assert payload["value"] > 0
     assert payload["supersteps"] == 1
     assert payload["dispatch_overhead_frac"] is None  # K=1: no comparison
+    # r6 phase attribution: the rollout/update split keys must be in
+    # every record (BENCH_r06 reads them to attribute the cycle)
+    for key in ("rollout_ms", "update_ms"):
+        assert key in payload, (key, payload)
+        assert payload[key] is not None and payload[key] > 0, (key, payload)

@@ -1,0 +1,180 @@
+"""Compact trajectory buffers + env_permute default plumbing (r6).
+
+``rollout_collect_dtype`` narrows ONLY the collected obs buffer (the
+widest trajectory array); actions/log-probs/values stay f32, so PPO's
+ratio numerics are untouched.  The resolution rule is "narrower of
+collect_dtype and policy_dtype": bf16 policies already stored bf16
+obs (the historical behavior test_train.py pins), so bf16 collect is
+the lossy opt-in only for f32 policies — and that loss is gated here
+by a learning-parity smoke.
+
+Also covers ``resolve_minibatch_scheme`` (the env_permute default
+flip's safety valve) and the committed parity-evidence artifact's
+contract.
+"""
+import json
+import warnings
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+from gymfx_tpu.train.ppo import (
+    PPOTrainer,
+    ppo_config_from,
+    resolve_collect_dtype,
+)
+
+from helpers import uptrend_df
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _trainer(**over):
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=8, ppo_horizon=16,
+                  ppo_epochs=1, ppo_minibatches=2,
+                  policy_kwargs={"hidden": [16, 16]})
+    config.update(over)
+    env = Environment(config, dataset=MarketDataset(uptrend_df(120), config))
+    return PPOTrainer(env, ppo_config_from(config))
+
+
+# ---------------------------------------------------------------------------
+# resolution rule
+# ---------------------------------------------------------------------------
+def test_resolve_collect_dtype_is_narrower_of_both():
+    assert resolve_collect_dtype({}, jnp.float32) == jnp.float32
+    assert resolve_collect_dtype(
+        {"rollout_collect_dtype": "bfloat16"}, jnp.float32
+    ) == jnp.bfloat16
+    # bf16 policies keep their historical bf16 storage regardless
+    assert resolve_collect_dtype({}, jnp.bfloat16) == jnp.bfloat16
+    assert resolve_collect_dtype(
+        {"rollout_collect_dtype": "float32"}, jnp.bfloat16
+    ) == jnp.bfloat16
+
+
+def test_bf16_collect_stores_bf16_obs_f32_everything_else():
+    tr = _trainer(rollout_collect_dtype="bfloat16")
+    assert tr.pcfg.collect_dtype == jnp.bfloat16
+    s = tr.init_state(0)
+    out = tr._rollout(s.params, s.env_states, s.obs_vec,
+                      s.policy_carry, s.rng)
+    traj = out[4]
+    assert traj["obs"].dtype == jnp.bfloat16
+    for key in ("action", "logp", "value", "reward"):
+        assert traj[key].dtype != jnp.bfloat16, key
+
+
+def test_bf16_collect_learning_parity_smoke():
+    """The quality-parity gate (docs/performance.md): an f32-policy
+    trainer with bf16 collect must LEARN — params move, losses stay
+    finite, and the first update's loss lands near the f32-collect
+    twin's (the obs quantization is ~3 decimal digits on z-scored,
+    clipped features)."""
+    import jax
+
+    tr32 = _trainer()
+    tr16 = _trainer(rollout_collect_dtype="bfloat16")
+    s32, m32 = tr32.train_step(tr32.init_state(0))
+    s16, m16 = tr16.train_step(tr16.init_state(0))
+    for key in ("loss", "policy_loss", "value_loss", "entropy"):
+        assert np.isfinite(float(m16[key])), key
+    assert float(m16["loss"]) == pytest.approx(float(m32["loss"]), abs=0.05)
+    # params actually moved
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(tr16.init_state(0).params),
+                        jax.tree.leaves(s16.params))
+    )
+    assert moved
+
+
+def test_core_rollout_collect_dtype_narrows_only_diagnostics():
+    from gymfx_tpu.core.rollout import random_driver, rollout
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1")
+    env = Environment(config, dataset=MarketDataset(uptrend_df(60), config))
+    import jax
+
+    rng = jax.random.PRNGKey(0)
+    _, full = rollout(env.cfg, env.params, env.data, random_driver(),
+                      20, rng)
+    _, slim = rollout(env.cfg, env.params, env.data, random_driver(),
+                      20, rng, collect_dtype=jnp.bfloat16)
+    for key in ("reward", "pending_sl", "pending_tp", "bracket_sl",
+                "bracket_tp"):
+        assert slim[key].dtype == jnp.bfloat16, key
+    # money math and integral streams stay untouched
+    for key in ("equity_delta", "equity", "done", "action", "position"):
+        assert slim[key].dtype == full[key].dtype, key
+    np.testing.assert_array_equal(
+        np.asarray(slim["equity_delta"]), np.asarray(full["equity_delta"])
+    )
+
+
+# ---------------------------------------------------------------------------
+# env_permute default + resolve safety valve
+# ---------------------------------------------------------------------------
+def test_env_permute_is_the_product_default():
+    assert DEFAULT_VALUES["ppo_minibatch_scheme"] == "env_permute"
+    tr = _trainer()  # 8 envs / 2 minibatches: divisible, no downgrade
+    assert tr.pcfg.minibatch_scheme == "env_permute"
+
+
+def test_resolve_minibatch_scheme_downgrades_only_impossible_configs():
+    from gymfx_tpu.train.common import resolve_minibatch_scheme
+
+    # n_envs < minibatches: env_permute cannot split — warn + downgrade
+    config = {"ppo_minibatch_scheme": "env_permute"}
+    with pytest.warns(UserWarning, match="falling back to sample_permute"):
+        resolve_minibatch_scheme(config, n_envs=1, minibatches=4)
+    assert config["ppo_minibatch_scheme"] == "sample_permute"
+
+    # feasible configs pass through silently (divisibility is still
+    # validated strictly at trainer construction)
+    config = {"ppo_minibatch_scheme": "env_permute"}
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        resolve_minibatch_scheme(config, n_envs=8, minibatches=4)
+    assert config["ppo_minibatch_scheme"] == "env_permute"
+
+
+def test_fresh_saved_config_treats_env_permute_as_default():
+    from gymfx_tpu.config.handler import compose_config
+
+    # the default scheme is dropped from a fresh config_out.json (it IS
+    # the default), while the legacy scheme now persists as an override
+    assert "ppo_minibatch_scheme" not in compose_config(
+        dict(DEFAULT_VALUES)
+    )
+    kept = compose_config(
+        dict(DEFAULT_VALUES, ppo_minibatch_scheme="sample_permute")
+    )
+    assert kept["ppo_minibatch_scheme"] == "sample_permute"
+
+
+# ---------------------------------------------------------------------------
+# committed parity-evidence artifact contract
+# ---------------------------------------------------------------------------
+def test_minibatch_parity_artifact_contract():
+    path = REPO / "examples/results/minibatch_scheme_parity.json"
+    assert path.exists(), (
+        "missing parity evidence — regenerate with "
+        "tools/minibatch_parity_evidence.py"
+    )
+    artifact = json.loads(path.read_text())
+    assert artifact["schema"] == "minibatch_scheme_parity.v1"
+    assert artifact["no_regression"] is True
+    schemes = {r["scheme"] for r in artifact["runs"]}
+    assert schemes == {"env_permute", "sample_permute"}
+    seeds = {r["seed"] for r in artifact["runs"] if r["scheme"] == "env_permute"}
+    assert len(seeds) >= 2, "parity claim needs multiple seeds"
+    for s in ("env_permute", "sample_permute"):
+        assert artifact["median_sharpe_held_out"][s] is not None

@@ -69,6 +69,90 @@ def test_neutral_steps_produce_zero_windows():
 
 
 # ---------------------------------------------------------------------------
+# fused per-step obs kernel (ops/window_zscore.fused_step_obs, r6):
+# the rollout hot-path variant — one env's (W, F) window + this step's
+# moments -> the scaled policy input, pinned BITWISE against the
+# plain-XLA oracle core/obs.scale_feature_window
+# ---------------------------------------------------------------------------
+class _ObsCfg:
+    def __init__(self, binary_mask=(), feature_clip=10.0):
+        self.binary_mask = tuple(binary_mask)
+        self.feature_clip = feature_clip
+
+
+def _step_obs_case(b=6, w=16, f=3, seed=0):
+    """Batched windows/moments with every edge the scaler handles:
+    NaN features, a zero-std column (inf -> clip), neutral rows."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    win = rng.normal(size=(b, w, f)).astype(np.float32)
+    win[0, 0, 0] = np.nan
+    mean = rng.normal(size=(b, f)).astype(np.float32)
+    std = np.abs(rng.normal(size=(b, f))).astype(np.float32) + 0.1
+    std[1, 2] = 0.0                      # inf path -> posinf/neginf fill
+    neutral = np.zeros(b, dtype=bool)
+    neutral[2] = True
+    return (jnp.asarray(win), jnp.asarray(mean), jnp.asarray(std),
+            jnp.asarray(neutral))
+
+
+@pytest.mark.parametrize("mask,clip", [
+    ((), 10.0),
+    ((False, True, False), 1.5),         # binary passthrough + tight clip
+    ((), 0.0),                           # clip disabled
+])
+def test_fused_step_obs_bitwise_matches_oracle(mask, clip):
+    import jax
+
+    from gymfx_tpu.core.obs import scale_feature_window
+    from gymfx_tpu.ops.window_zscore import fused_step_obs
+
+    win, mean, std, neutral = _step_obs_case()
+    cfg = _ObsCfg(binary_mask=mask or (False,) * 3, feature_clip=clip)
+    ref = jax.vmap(
+        lambda w_, m_, s_, n_: scale_feature_window(w_, m_, s_, n_, cfg)
+    )(win, mean, std, neutral)
+    # vmapped: the custom_vmap rule folds envs into the blocked grid
+    ours = jax.vmap(
+        lambda w_, m_, s_, n_: fused_step_obs(
+            w_, m_, s_, n_, binary_mask=cfg.binary_mask,
+            clip=cfg.feature_clip, interpret=True,
+        )
+    )(win, mean, std, neutral)
+    np.testing.assert_array_equal(np.asarray(ours), np.asarray(ref))
+    # unvmapped single env (batch-of-1 kernel path)
+    one = fused_step_obs(
+        win[0], mean[0], std[0], neutral[0],
+        binary_mask=cfg.binary_mask, clip=cfg.feature_clip, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(ref[0]))
+
+
+def test_fused_step_obs_vmap_broadcasts_unbatched_moments():
+    """in_axes=(0, None, None, None): the def_vmap rule must broadcast
+    the shared moments across the env axis."""
+    import jax
+
+    from gymfx_tpu.core.obs import scale_feature_window
+    from gymfx_tpu.ops.window_zscore import fused_step_obs
+
+    win, mean, std, neutral = _step_obs_case(b=4)
+    cfg = _ObsCfg(binary_mask=(False,) * 3, feature_clip=10.0)
+    ours = jax.vmap(
+        lambda w_: fused_step_obs(
+            w_, mean[0], std[0], neutral[0],
+            binary_mask=cfg.binary_mask, clip=cfg.feature_clip,
+            interpret=True,
+        )
+    )(win)
+    ref = jax.vmap(
+        lambda w_: scale_feature_window(w_, mean[0], std[0], neutral[0], cfg)
+    )(win)
+    np.testing.assert_array_equal(np.asarray(ours), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
 # fused window attention (ops/fused_attention.py, VERDICT r4 weak #5)
 # ---------------------------------------------------------------------------
 def _qkv(shape, seed=0):
@@ -94,9 +178,10 @@ def test_fused_attention_matches_reference(shape, causal):
 
 
 def test_fused_attention_gradients_match_reference():
-    """The custom VJP (pallas forward, XLA-recompute backward) must
-    produce the reference gradients — the kernel is on the TRAINING
-    path of the transformer policies."""
+    """The custom VJP (pallas forward AND fused pallas backward, which
+    recomputes the probabilities in VMEM) must produce the reference
+    gradients — the kernel is on the TRAINING path of the transformer
+    policies."""
     import jax
     import jax.numpy as jnp
 
@@ -115,6 +200,36 @@ def test_fused_attention_gradients_match_reference():
 
     g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+@pytest.mark.tpu
+def test_fused_attention_gradients_exact_on_tpu():
+    """Grad exactness of the COMPILED fused backward on a real chip
+    (interpret-mode coverage above can't catch Mosaic lowering bugs).
+    Skipped automatically off-TPU."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("requires a real TPU (compiled pallas backward)")
+    import jax.numpy as jnp
+
+    from gymfx_tpu.ops.fused_attention import fused_window_attention
+    from gymfx_tpu.parallel.ring_attention import full_attention
+
+    q, k, v = _qkv((256, 4, 32), seed=5)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(
+            fused_window_attention(q, k, v, interpret=False) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v) ** 2)
+
+    g_fused = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
     for a, b in zip(g_fused, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 

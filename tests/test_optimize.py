@@ -53,6 +53,29 @@ def test_candidates_actually_change_outcomes():
     assert len({round(float(x), 9) for x in rap}) > 1  # not all identical
 
 
+def test_boundary_clipped_winner_is_flagged():
+    """A winner pinned to a schema bound (the k_tp=1.5-floor class of
+    result) must be marked in the summary — the bound, not the search,
+    chose that value (tools/optimize_evidence.py surfaces the flag)."""
+    env = _env()
+    opt = Optimizer(env, [("k_sl", 1.0, 4.0), ("k_tp", 1.5, 6.0)],
+                    population=8, episode_steps=100)
+    result = opt.run(generations=2, seed=1)
+    assert "boundary_clipped" in result
+    lohi = {"k_sl": (1.0, 4.0), "k_tp": (1.5, 6.0)}
+    for name, side in result["boundary_clipped"].items():
+        lo, hi = lohi[name]
+        tol = 1e-3 * (hi - lo)
+        v = result["best_params"][name]
+        assert (v <= lo + tol) if side == "low" else (v >= hi - tol)
+    # and interior winners are NOT flagged
+    for name, v in result["best_params"].items():
+        lo, hi = lohi[name]
+        tol = 1e-3 * (hi - lo)
+        if lo + tol < v < hi - tol:
+            assert name not in result["boundary_clipped"]
+
+
 def test_unknown_hparam_rejected():
     env = _env()
     with pytest.raises(ValueError, match="unknown hyperparameter"):

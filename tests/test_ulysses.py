@@ -77,7 +77,9 @@ def test_batched_ulysses_inner_matches_full():
             qb, kb, vb, axis="seq", n_shards=N_DEV, causal=True
         )
 
-    out = jax.shard_map(
+    from gymfx_tpu.parallel.mesh import shard_map
+
+    out = shard_map(
         f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
     ref = full_attention(q, k, v, causal=True)

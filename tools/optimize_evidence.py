@@ -82,13 +82,26 @@ def main() -> int:
     history = result["history"]
     stds = [h["rap_std"] for h in history]
     improved = history[-1]["best_rap"] >= history[0]["best_rap"]
+    boundary = result.get("boundary_clipped") or {}
     print(json.dumps({
         "best_params": result["best_params"],
         "best_rap": result["best_rap"],
+        "boundary_clipped": boundary,
         "rap_std_by_generation": stds,
         "held_out": result.get("held_out"),
         "wall_seconds": round(wall, 2),
     }), flush=True)
+    if boundary:
+        # surfaced loudly, not buried in the JSON: a bound-pinned winner
+        # means the schema box, not the search, chose the value
+        print(
+            "NOTE: winner is pinned to schema bound(s) "
+            + ", ".join(f"{k}={v}" for k, v in sorted(boundary.items()))
+            + " — the searched box is the binding constraint there; "
+            "widen the bound (optimize_params) to let the GA converge "
+            "freely",
+            file=sys.stderr,
+        )
 
     if not result["selection_signal"]:
         print(
@@ -116,6 +129,7 @@ def main() -> int:
                   "spread > 0 and the winner held-out-evaluated "
                   "automatically",
         "selection_signal": result["selection_signal"],
+        "boundary_clipped": boundary,
         "best_rap_improved_over_generations": bool(improved),
         "wall_seconds": round(wall, 2),
         "config": {

@@ -28,7 +28,6 @@ import argparse
 import datetime
 import json
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -76,7 +75,7 @@ def _impala_trainer(n_envs: int, unroll: int, window: int = 32):
     return ImpalaTrainer(env, impala_config_from(config))
 
 
-def _portfolio_trainer(n_envs: int, horizon: int, window: int = 32):
+def _portfolio_trainer(n_envs: int, horizon: int, window: int = 32, **over):
     from gymfx_tpu.core.portfolio import PortfolioEnvironment
     from gymfx_tpu.train.portfolio_ppo import (
         PortfolioPPOConfig,
@@ -93,8 +92,13 @@ def _portfolio_trainer(n_envs: int, horizon: int, window: int = 32):
             "window_size": window,
         }
     )
-    pcfg = PortfolioPPOConfig(n_envs=n_envs, horizon=horizon, epochs=1,
-                              minibatches=4, policy="mlp")
+    pcfg = PortfolioPPOConfig(
+        n_envs=n_envs, horizon=horizon, epochs=1, minibatches=4,
+        policy="mlp",
+        minibatch_scheme=str(
+            over.get("ppo_minibatch_scheme", "sample_permute")
+        ),
+    )
     return PortfolioPPOTrainer(env, pcfg)
 
 
@@ -119,25 +123,21 @@ def _measure(trainer, n_envs: int, horizon: int, iters: int,
             jax.block_until_ready(state)
 
     split = None
-    # the split harness drives the single-pair PPO rollout signature
-    # (params, env_states, obs_vec, policy_carry, rng) and reads
-    # state.params — guard on BOTH (ImpalaState carries policy_carry
-    # but names its params learner_params; portfolio has neither)
-    if split_rollout and hasattr(state, "policy_carry") and hasattr(state, "params"):
-        roll = jax.jit(trainer._rollout)
-        out = roll(state.params, state.env_states, state.obs_vec,
-                   state.policy_carry, state.rng)
-        jax.block_until_ready(out[4])
-        r0 = time.perf_counter()
-        for _ in range(iters):
-            out = roll(state.params, state.env_states, state.obs_vec,
-                       state.policy_carry, state.rng)
-        jax.block_until_ready(out[4])
-        rdt = time.perf_counter() - r0
-        split = {
-            "rollout_seconds_per_iter": rdt / iters,
-            "update_seconds_per_iter": max(dt - rdt, 0.0) / iters,
-        }
+    # r6: the split times BOTH halves directly as donated-carry compiled
+    # sub-programs (the _rollout_phase/_update_phase methods every
+    # trainer's fused step composes — bench_util.measure_phase_split),
+    # replacing the earlier subtract-rollout-from-total estimate and
+    # working uniformly across PPO/IMPALA/portfolio
+    if split_rollout:
+        from gymfx_tpu.bench_util import measure_phase_split
+
+        ps = measure_phase_split(trainer, state, iters)
+        if ps is not None:
+            rollout_s, update_s, state = ps
+            split = {
+                "rollout_seconds_per_iter": rollout_s / iters,
+                "update_seconds_per_iter": update_s / iters,
+            }
 
     steps = n_envs * horizon * iters
     device = jax.devices()[0]
@@ -169,8 +169,8 @@ def main() -> int:
                  ("lstm", 64, 16, False, 32, {}),
                  ("transformer_ring", 32, 16, False, 32, {}),
                  ("transformer_ring", 16, 16, False, 128, {}),
-                 ("impala_lstm", 64, 16, False, 32, {}),
-                 ("portfolio_mlp", 32, 16, False, 32, {})]
+                 ("impala_lstm", 64, 16, True, 32, {}),
+                 ("portfolio_mlp", 32, 16, True, 32, EP)]
         args.iters = 2
     else:
         jobs = [
@@ -192,12 +192,19 @@ def main() -> int:
             ("transformer_ring", 256, horizon, True, 256, {}),
             ("impala_lstm", 4096, horizon, False, 32, {}),
             ("portfolio_mlp", 2048, horizon, False, 32, {}),
+            # r6 re-bench under the new env_permute product default
+            # (portfolio) and with the phase-attributed split (impala —
+            # which has no minibatch permutation at all: V-trace replays
+            # whole env trajectories, so the env-blocked layout is
+            # inherent and only the split row is new)
+            ("portfolio_mlp", 2048, horizon, True, 32, EP),
+            ("impala_lstm", 4096, horizon, True, 32, {}),
         ]
 
     rows = []
     for policy, n_envs, hor, split, window, over in jobs:
         if policy == "portfolio_mlp":
-            trainer = _portfolio_trainer(n_envs, hor, window)
+            trainer = _portfolio_trainer(n_envs, hor, window, **over)
         elif policy == "impala_lstm":
             trainer = _impala_trainer(n_envs, hor, window)
         else:
@@ -223,6 +230,12 @@ def main() -> int:
             row["n_pairs"] = 3
         if over.get("ppo_minibatch_scheme"):
             row["minibatch_scheme"] = over["ppo_minibatch_scheme"]
+        if policy == "impala_lstm" and split:
+            row["note"] = (
+                "IMPALA has no minibatch permutation scheme: V-trace "
+                "replays whole env trajectories every update, so the "
+                "env-blocked (env_permute-like) layout is inherent"
+            )
         if split_out:
             row["wall_split"] = {
                 k: round(v, 5) for k, v in split_out.items()
@@ -235,6 +248,16 @@ def main() -> int:
     # measured rollout/update wall splits instead of hand-edited notes
     # (so regeneration never loses the explanation)
     notes = {
+        "wall_split_method": (
+            "r6: wall_split times the rollout and update halves directly "
+            "as donated-carry compiled sub-programs of the SAME phase "
+            "methods the fused step composes "
+            "(bench_util.measure_phase_split) — earlier sweeps estimated "
+            "update as total-minus-rollout.  The two phase dispatches "
+            "sum slightly above the fused step (extra dispatch + host "
+            "sync, no cross-phase fusion), so read the split as a "
+            "fraction of the fused per-step time"
+        ),
         "iteration_count": (
             f"every row uses {args.iters} timed iterations. Each dispatch "
             "pays ~10ms of host->device round-trip over the remote-device "

@@ -75,6 +75,12 @@ def main() -> int:
         feature_scaling="rolling_zscore", feature_scaling_window=64,
         gamma=0.9, learning_rate=2e-4,
         train_total_steps=args.train_total_steps,
+        # r6 product defaults, pinned explicitly so the artifact records
+        # them: trajectory (env-permuted) minibatches and bf16 trajectory
+        # obs storage (bit-identical downstream here — the bf16 policy
+        # casts its input anyway; docs/performance.md)
+        ppo_minibatch_scheme="env_permute",
+        rollout_collect_dtype="bfloat16",
     )
     if args.quick:
         config.update(
@@ -135,6 +141,8 @@ def main() -> int:
             "random_episode_start": True,
             "eval_split": config["eval_split"],
             "train_total_steps": config["train_total_steps"],
+            "ppo_minibatch_scheme": config["ppo_minibatch_scheme"],
+            "rollout_collect_dtype": config["rollout_collect_dtype"],
         },
         "result": {
             # wall clock INCLUDES XLA compilation of the train + eval
