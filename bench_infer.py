@@ -13,8 +13,17 @@ scheduler.  Three numbers are measured off the same warm engine:
     observations through the MicroBatcher; p50/p99 wall latency comes
     from its per-request records (enqueue -> resolve).
 
+A fourth phase is a scripted OVERLOAD scenario (docs/serving.md): the
+engine is wrapped in a seeded FlakyEngine (slow dispatches), a second
+admission-controlled batcher (small queue, 50ms deadlines) takes
+burst-shaped arrivals, and the line reports the serving SLO trio —
+``shed_rate``, ``deadline_miss_rate`` and the overload ``p99_ms``.
+``--fault_profile`` overrides the scripted scenario (grammar in
+gymfx_tpu/resilience/faults.py).
+
 Usage: python bench_infer.py [--policy P] [--batch N] [--iters K]
                              [--clients C] [--wait_ms W] [--quick]
+                             [--fault_profile SPEC]
 """
 import argparse
 import json
@@ -42,6 +51,9 @@ def main() -> None:
                     help="micro-batcher coalescing window")
     ap.add_argument("--batch_mode", default="auto",
                     choices=("auto", "exact", "matmul"))
+    ap.add_argument("--fault_profile", default="",
+                    help="overload-phase fault profile (default: the "
+                         "scripted burst-overload scenario)")
     ap.add_argument("--quick", action="store_true", help="small shapes (CI)")
     args = ap.parse_args()
     buckets = None
@@ -153,6 +165,73 @@ def main() -> None:
         else 0.0
     )
 
+    # --- scripted overload scenario (chaos phase) -----------------------
+    # a second, admission-controlled batcher over a FlakyEngine: slow
+    # 80ms dispatches, max 8-wide batches, a 16-deep queue and 50ms
+    # deadlines under 4 bursts of 32 simultaneous arrivals — structural
+    # overload, so the shed/deadline machinery measurably engages while
+    # the phases above keep exercising the untouched fast path
+    from gymfx_tpu.resilience import (
+        flaky_engine_from_profile,
+        parse_fault_profile,
+    )
+    from gymfx_tpu.serve import DeadlineExceeded, ShedError
+
+    profile_spec = args.fault_profile or (
+        "serve=" + "+".join(["slow:80"] * 16) + ";burst=32x4;seed=0"
+    )
+    profile = parse_fault_profile(profile_spec)
+    burst = profile.get("burst") or {"size": 32, "rounds": 4}
+    flaky = flaky_engine_from_profile(engine, profile)
+    over = MicroBatcher(
+        flaky,
+        max_batch_wait_ms=1.0,
+        max_batch=8,
+        max_queue=16,
+        shed_policy="reject",
+        default_deadline_ms=50.0,
+    )
+    outcomes = {"served": 0, "shed": 0, "deadline_miss": 0, "failed": 0}
+    outcome_lock = threading.Lock()
+
+    def burst_client(i: int) -> None:
+        carry = engine.initial_carry() if engine.recurrent else None
+        try:
+            fut = over.submit(rows[i % args.batch], carry)
+            fut.result(timeout=30.0)
+            kind = "served"
+        except ShedError:
+            kind = "shed"
+        except DeadlineExceeded:
+            kind = "deadline_miss"
+        except Exception:
+            kind = "failed"
+        with outcome_lock:
+            outcomes[kind] += 1
+
+    t0 = time.perf_counter()
+    for r in range(int(burst["rounds"])):
+        wave = [
+            threading.Thread(
+                target=burst_client, args=(r * int(burst["size"]) + i,)
+            )
+            for i in range(int(burst["size"]))
+        ]
+        for t in wave:
+            t.start()
+        for t in wave:
+            t.join()
+    over_wall = time.perf_counter() - t0
+    over_records = over.records
+    over_health = over.health()
+    over.close()
+    submitted = int(burst["size"]) * int(burst["rounds"])
+    over_lat_ms = np.asarray(
+        [r.latency_s for r in over_records] or [0.0]
+    ) * 1e3
+    shed_rate = outcomes["shed"] / submitted
+    deadline_miss_rate = outcomes["deadline_miss"] / submitted
+
     chips = max(1, jax.local_device_count())
     print(
         json.dumps(
@@ -176,6 +255,26 @@ def main() -> None:
                 "latency_throughput_per_sec": round(
                     len(records) / lat_wall, 1
                 ),
+                # serving SLO trio under the scripted overload scenario
+                "shed_rate": round(shed_rate, 4),
+                "deadline_miss_rate": round(deadline_miss_rate, 4),
+                "overload": {
+                    "fault_profile": profile_spec,
+                    "submitted": submitted,
+                    "served": outcomes["served"],
+                    "shed": outcomes["shed"],
+                    "deadline_missed": outcomes["deadline_miss"],
+                    "failed": outcomes["failed"],
+                    "p99_ms": round(
+                        float(np.percentile(over_lat_ms, 99)), 3
+                    ),
+                    "wall_s": round(over_wall, 3),
+                    "shed_count": over_health["shed_count"],
+                    "deadline_miss_count": over_health[
+                        "deadline_miss_count"
+                    ],
+                    "dispatch_failures": over_health["dispatch_failures"],
+                },
             }
         )
     )

@@ -76,4 +76,17 @@ def parse_args(argv=None):
         "--serve_batch_mode", choices=["auto", "exact", "matmul"]
     )
 
+    # serving overload resilience (docs/serving.md, "Overload behavior")
+    parser.add_argument("--serve_max_queue", type=int)
+    parser.add_argument(
+        "--serve_shed_policy", choices=["reject", "evict_oldest"]
+    )
+    parser.add_argument("--serve_deadline_ms", type=float)
+    parser.add_argument(
+        "--serve_fallback", choices=["hold", "flat", "reject"]
+    )
+    parser.add_argument("--serve_breaker_threshold", type=int)
+    parser.add_argument("--serve_breaker_recovery_s", type=float)
+    parser.add_argument("--feed_stale_after_s", type=float)
+
     return parser.parse_known_args(argv)

@@ -142,4 +142,33 @@ DEFAULT_VALUES = {
     # compile + run every bucket at engine construction (False defers
     # to first use — only for tooling that never serves)
     "serve_warmup": True,
+    # ---- serving overload resilience (docs/serving.md, "Overload
+    # behavior") — admission control is OFF by default (unbounded
+    # queue, no deadlines), so the bare serving path behaves exactly
+    # as before; production configs bound both.
+    # admission queue capacity (requests queued ahead of the batching
+    # window); null = unbounded
+    "serve_max_queue": None,
+    # full-queue shed policy: reject (newest submit fails fast with
+    # ShedError) | evict_oldest (oldest queued request is dropped so the
+    # freshest data wins)
+    "serve_shed_policy": "reject",
+    # per-request deadline; a request that cannot dispatch before it
+    # fails fast with DeadlineExceeded instead of occupying a batch
+    # slot.  null = no deadline
+    "serve_deadline_ms": None,
+    # live degraded-mode fallback when the serving path sheds / misses
+    # a deadline / trips the breaker: hold (keep the current pending
+    # target, no venue traffic) | flat (route to flat) | reject (raise
+    # the typed error to the caller)
+    "serve_fallback": "hold",
+    # serving circuit breaker around engine dispatch: consecutive
+    # dispatch failures to trip OPEN (0 disables), and the open ->
+    # half-open recovery window
+    "serve_breaker_threshold": 5,
+    "serve_breaker_recovery_s": 5.0,
+    # live stale-feed watchdog: when the gap since the previous bar
+    # exceeds this many seconds, PolicyDecisionService decides via the
+    # fallback policy instead of acting on a stale window.  null = off
+    "feed_stale_after_s": None,
 }

@@ -4,6 +4,18 @@ The only implementation is the OANDA v20 REST broker
 (`gymfx_tpu.live.oanda`), the working twin of the reference's
 `bt.stores.OandaStore` broker (reference broker_plugins/oanda_broker.py:58-63).
 """
-from gymfx_tpu.live.oanda import OandaLiveBroker, TargetOrderRouter
+from gymfx_tpu.live.oanda import (
+    DecisionRecord,
+    FeedStaleError,
+    OandaLiveBroker,
+    PolicyDecisionService,
+    TargetOrderRouter,
+)
 
-__all__ = ["OandaLiveBroker", "TargetOrderRouter"]
+__all__ = [
+    "DecisionRecord",
+    "FeedStaleError",
+    "OandaLiveBroker",
+    "PolicyDecisionService",
+    "TargetOrderRouter",
+]

@@ -27,7 +27,10 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Callable, Dict, Optional
+from collections import deque
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import numpy as np
 
 PRACTICE_HOST = "https://api-fxpractice.oanda.com"
 LIVE_HOST = "https://api-fxtrade.oanda.com"
@@ -520,6 +523,55 @@ class TargetOrderRouter:
         )
 
 
+class FeedStaleError(RuntimeError):
+    """The live bar feed went stale: the gap since the previous bar
+    exceeded ``feed_stale_after_s``, so a decision on the current
+    observation window would act on old data."""
+
+    def __init__(self, age_s: float, threshold_s: float):
+        super().__init__(
+            f"bar feed stale: {age_s:.1f}s since the previous bar "
+            f"(feed_stale_after_s={threshold_s:g})"
+        )
+        self.age_s = float(age_s)
+        self.threshold_s = float(threshold_s)
+
+
+class DecisionRecord(NamedTuple):
+    """Audit row for one serve decision.  ``source`` is ``"model"`` for
+    real engine output or ``"fallback"`` for a synthetic degraded-mode
+    decision; fallback rows carry the ``reason`` (``shed`` / ``deadline``
+    / ``breaker_open`` / ``batcher_closed`` / ``dispatch_error`` /
+    ``stale_feed``) so downstream reconciliation can tell a routed
+    target that came from the policy apart from one the overload
+    machinery synthesized."""
+
+    seq: int              # 1-based decide() counter
+    bar: int              # session bar cursor at decision time
+    action: int           # the env action that was (or would be) routed
+    source: str           # "model" | "fallback"
+    reason: Optional[str]  # None for model decisions
+
+
+def _overload_reason(exc: BaseException) -> str:
+    from gymfx_tpu.resilience.retry import CircuitOpenError
+    from gymfx_tpu.serve.overload import (
+        BatcherClosedError,
+        DeadlineExceeded,
+        ShedError,
+    )
+
+    if isinstance(exc, ShedError):
+        return "shed"
+    if isinstance(exc, DeadlineExceeded):
+        return "deadline"
+    if isinstance(exc, CircuitOpenError):
+        return "breaker_open"
+    if isinstance(exc, BatcherClosedError):
+        return "batcher_closed"
+    return "dispatch_error"
+
+
 class PolicyDecisionService:
     """Warm policy serving glued to a :class:`TargetOrderRouter`.
 
@@ -545,6 +597,17 @@ class PolicyDecisionService:
     0 -> hold (keep the current target; nothing is routed).
     Continuous policies are already thresholded to {0, 1, 2} by the
     engine with the env's own coercion threshold.
+
+    Overload resilience (docs/serving.md, "Overload behavior"): engine
+    dispatch runs behind a serving :class:`CircuitBreaker` (or through
+    an admission-controlled ``batcher``), and when the serving path
+    sheds, misses a deadline, trips the breaker, or the bar feed goes
+    stale (``feed_stale_after_s``), the configured ``serve_fallback``
+    policy produces a SYNTHETIC decision instead — ``hold`` keeps the
+    current pending target (no venue traffic), ``flat`` routes to flat,
+    ``reject`` re-raises the typed error.  Every decision (model or
+    fallback) appends a tagged :class:`DecisionRecord`, so downstream
+    reconciliation always knows which routed targets were synthetic.
     """
 
     def __init__(
@@ -556,7 +619,11 @@ class PolicyDecisionService:
         params: Any = None,
         env: Any = None,
         units: Optional[float] = None,
+        batcher: Any = None,
+        breaker: Any = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
+        from gymfx_tpu.serve.config import serve_config_from
         from gymfx_tpu.serve.engine import engine_from_config
         from gymfx_tpu.serve.features import BarFeaturizer, make_host_encoder
 
@@ -583,7 +650,84 @@ class PolicyDecisionService:
         self.target_units = 0.0  # last routed pending target
         self.decisions = 0
 
+        scfg = serve_config_from(config)
+        self.fallback_policy = scfg.fallback
+        self.deadline_ms = scfg.deadline_ms
+        self.feed_stale_after_s = scfg.feed_stale_after_s
+        # dispatch path: an injected admission-controlled MicroBatcher
+        # (multi-session serving; it carries its own breaker), else
+        # direct engine dispatch behind the serving breaker
+        self.batcher = batcher
+        if breaker is None and batcher is None and scfg.breaker_threshold:
+            from gymfx_tpu.resilience.retry import CircuitBreaker
+
+            breaker = CircuitBreaker(
+                scfg.breaker_threshold, scfg.breaker_recovery_s
+            )
+        self.breaker = breaker
+        self._clock = clock
+        self._last_bar_at: Optional[float] = None
+        self.fallback_count = 0
+        self.feed_stale_count = 0
+        self.last_fallback_reason: Optional[str] = None
+        self.decision_records = deque(maxlen=100_000)
+
     # ------------------------------------------------------------------
+    def feed_age_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the previous bar arrived (None before the
+        first bar)."""
+        if self._last_bar_at is None:
+            return None
+        return (self._clock() if now is None else now) - self._last_bar_at
+
+    def _model_decide(self, row):
+        """One engine dispatch through the configured path; raises the
+        typed overload errors (serve/overload.py) on the brownout
+        paths."""
+        if self.batcher is not None:
+            fut = self.batcher.submit(
+                row, self._carry, deadline_ms=self.deadline_ms
+            )
+            # the deadline machinery resolves the future; the extra
+            # slack only guards against a wedged worker thread
+            timeout = (
+                None
+                if self.deadline_ms is None
+                else self.deadline_ms / 1e3 + 30.0
+            )
+            return fut.result(timeout=timeout)
+        if self.breaker is not None:
+            self.breaker.allow()  # raises CircuitOpenError while open
+        try:
+            decision = self.engine.decide(row, self._carry)
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        if self.breaker is not None:
+            self.breaker.record_success()
+        return decision
+
+    def _fallback_decision(self, reason: str, exc: BaseException):
+        """Synthesize the degraded-mode decision (or re-raise under the
+        ``reject`` policy).  The recurrent carry is left untouched —
+        the model never saw this bar."""
+        self.last_fallback_reason = reason
+        if self.fallback_policy == "reject":
+            raise exc
+        self.fallback_count += 1
+        from gymfx_tpu.serve.engine import Decision
+
+        action = 0 if self.fallback_policy == "hold" else 3
+        # NaN value/actor_out: a synthetic decision has no model output
+        # to audit, and NaN is loud in any downstream aggregation
+        return Decision(
+            np.int32(action),
+            np.float32(np.nan),
+            np.float32(np.nan),
+            self._carry,
+        )
+
     def decide(
         self,
         close: float,
@@ -594,7 +738,19 @@ class PolicyDecisionService:
         """Featurize one bar and run the warm engine on it (no routing).
 
         Returns the serve Decision row; recurrent carry streams in the
-        service between calls."""
+        service between calls.  On a stale feed or a serving-path
+        overload error the decision comes from the fallback policy and
+        is tagged in :attr:`decision_records`."""
+        now = self._clock()
+        stale_age = (
+            None
+            if (self.feed_stale_after_s is None or self._last_bar_at is None)
+            else now - self._last_bar_at
+        )
+        stale = (
+            stale_age is not None and stale_age > self.feed_stale_after_s
+        )
+        self._last_bar_at = now
         self.session.push(close, features)
         obs = self.session.obs(
             pos_sign=float(
@@ -603,10 +759,38 @@ class PolicyDecisionService:
             equity_delta=equity_delta,
         )
         row = self._encode(obs)
-        decision = self.engine.decide(row, self._carry)
-        if self.engine.recurrent:
-            self._carry = decision.carry
+        source, reason = "model", None
+        if stale:
+            # the window behind this bar has a gap the policy never
+            # trained on — decide via the fallback, not the model
+            self.feed_stale_count += 1
+            source, reason = "fallback", "stale_feed"
+            decision = self._fallback_decision(
+                reason, FeedStaleError(stale_age, self.feed_stale_after_s)
+            )
+        else:
+            from gymfx_tpu.serve.overload import OVERLOAD_ERRORS
+
+            try:
+                decision = self._model_decide(row)
+                if self.engine.recurrent:
+                    self._carry = decision.carry
+            except OVERLOAD_ERRORS as exc:
+                source, reason = "fallback", _overload_reason(exc)
+                decision = self._fallback_decision(reason, exc)
+            except Exception as exc:  # dispatch fault before the breaker opens
+                source, reason = "fallback", "dispatch_error"
+                decision = self._fallback_decision(reason, exc)
         self.decisions += 1
+        self.decision_records.append(
+            DecisionRecord(
+                seq=self.decisions,
+                bar=int(self.session.bars_seen),
+                action=int(decision.action),
+                source=source,
+                reason=reason,
+            )
+        )
         return decision
 
     def decide_and_route(

@@ -34,10 +34,13 @@ from gymfx_tpu.resilience.retry import (
 )
 from gymfx_tpu.resilience.loop import ResilientLoop
 from gymfx_tpu.resilience.faults import (
+    FlakyEngine,
     FlakyTransport,
+    InjectedDispatchError,
     SimulatedPreemptionError,
     apply_fault_profile_to_market_data,
     contaminate_market_data,
+    flaky_engine_from_profile,
     nonfinite_report,
     parse_fault_profile,
 )
@@ -55,10 +58,13 @@ __all__ = [
     "RetryPolicy",
     "retry_call",
     "ResilientLoop",
+    "FlakyEngine",
     "FlakyTransport",
+    "InjectedDispatchError",
     "SimulatedPreemptionError",
     "apply_fault_profile_to_market_data",
     "contaminate_market_data",
+    "flaky_engine_from_profile",
     "nonfinite_report",
     "parse_fault_profile",
 ]

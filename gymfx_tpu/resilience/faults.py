@@ -7,6 +7,11 @@ plain red test, not a flake.
   FlakyTransport           wraps a live-path transport with a scripted
                            fault plan (timeouts, connection drops, 5xx,
                            accept-then-fail, truncated bodies);
+  FlakyEngine              wraps a serving InferenceEngine with scripted
+                           DISPATCH faults (slow dispatch, stalled
+                           worker, engine exceptions) — the serving
+                           chaos harness behind bench_infer.py's
+                           burst-overload scenario;
   contaminate_market_data  injects NaN/inf into feed windows (the bars
                            AND the padded obs window, so both the
                            reward path and the policy input see them);
@@ -16,6 +21,7 @@ plain red test, not a flake.
 Profile grammar — semicolon-separated ``key=value`` clauses::
 
     nan_bars=30-31;transport=http:503,http:503,ok;seed=7
+    serve=slow:40+slow:40+exc+ok;burst=32x4;seed=0
 
   nan_bars / inf_bars   bar indices to poison: ``N``, ``N-M`` (inclusive)
                         or ``N,M,K`` (comma list within the clause is
@@ -24,6 +30,13 @@ Profile grammar — semicolon-separated ``key=value`` clauses::
                         poison (default ``close``)
   transport             ``+``- or ``,``-joined fault tokens consumed one
                         per HTTP call (see FAULT_TOKENS)
+  serve                 ``+``- or ``,``-joined serving fault tokens
+                        consumed one per engine dispatch (see
+                        SERVE_FAULT_TOKENS), or ``pR`` for a seeded
+                        probabilistic plan at rate R
+  burst                 ``NxK`` — the burst-arrival shape for overload
+                        scenarios: K rounds of N simultaneous requests
+                        (consumed by bench_infer.py's chaos phase)
   preempt_at            iteration index after which the trainer raises
                         SimulatedPreemptionError (checkpoint drill)
   seed                  seed for probabilistic plans (``transport=p0.3``)
@@ -46,6 +59,131 @@ FAULT_TOKENS = (
                         # (lookup-first) from double-fill (blind resubmit)
     "partial",          # venue processes, body truncated mid-JSON
 )
+
+
+SERVE_FAULT_TOKENS = (
+    "ok",           # dispatch passes through untouched
+    "slow:<ms>",    # dispatch completes after an injected delay —
+                    # queued requests age past their deadlines
+    "stall:<ms>",   # a long injected delay standing in for a wedged
+                    # worker/runtime (same mechanics as slow, separate
+                    # token so plans read as what they simulate)
+    "exc",          # the dispatch raises InjectedDispatchError — feeds
+                    # the serving circuit breaker
+)
+
+
+class InjectedDispatchError(RuntimeError):
+    """Injected engine-dispatch failure (the serving chaos harness's
+    stand-in for an XLA runtime error / device loss mid-dispatch)."""
+
+
+class FlakyEngine:
+    """Deterministic chaos wrapper around a serving InferenceEngine.
+
+    Intercepts ``decide_batch`` (the batcher's dispatch path) with a
+    scripted fault plan consumed one token per dispatch — dispatches
+    beyond the plan pass through — or a seeded probabilistic plan
+    (``failure_rate`` + ``rate_tokens``).  Every other attribute
+    (buckets, recurrent, obs_dtype, initial_carry, bucket_for, ...)
+    delegates to the wrapped engine, so the wrapper drops into
+    ``MicroBatcher(engine=...)`` unchanged.  ``sleep`` is injectable so
+    tests can run stall plans instantly.
+    """
+
+    def __init__(
+        self,
+        inner: Any,
+        *,
+        plan: Sequence[str] = (),
+        failure_rate: float = 0.0,
+        rate_tokens: Sequence[str] = ("slow:50", "exc"),
+        seed: int = 0,
+        sleep: Callable[[float], None] = None,
+    ):
+        import time as _time
+
+        self._inner = inner
+        self._plan: List[str] = [str(t) for t in plan]
+        self._rate = float(failure_rate)
+        self._rate_tokens = tuple(rate_tokens)
+        self._rng = random.Random(seed)
+        self._sleep = _time.sleep if sleep is None else sleep
+        self.dispatch_calls = 0
+        self.faults_injected = 0
+        self.history: List[str] = []
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def _next_token(self) -> str:
+        if self._plan:
+            return self._plan.pop(0)
+        if self._rate > 0.0 and self._rng.random() < self._rate:
+            return self._rng.choice(self._rate_tokens)
+        return "ok"
+
+    def decide_batch(self, obs_batch: Any, carries: Any = None):
+        self.dispatch_calls += 1
+        token = self._next_token()
+        self.history.append(token)
+        if token == "ok":
+            return self._inner.decide_batch(obs_batch, carries)
+        self.faults_injected += 1
+        if token.startswith(("slow:", "stall:")):
+            self._sleep(float(token.split(":", 1)[1]) / 1e3)
+            return self._inner.decide_batch(obs_batch, carries)
+        if token == "exc":
+            raise InjectedDispatchError(
+                "injected engine dispatch failure"
+            )
+        raise ValueError(
+            f"unknown serve fault token {token!r}; known: {SERVE_FAULT_TOKENS}"
+        )
+
+    def decide(self, obs_vec: Any, carry: Any = None):
+        """Single-request convenience routed through the FAULTED
+        ``decide_batch`` (the inner engine's own ``decide`` would bypass
+        the plan), so the live direct-dispatch path is chaos-testable
+        too."""
+        import jax
+
+        carries = None
+        if self._inner.recurrent:
+            if carry is None:
+                carry = self._inner.initial_carry()
+            carries = jax.tree.map(lambda x: np.asarray(x)[None], carry)
+        out = self.decide_batch(np.asarray(obs_vec)[None], carries)
+        return type(out)(
+            out.action[0],
+            out.value[0],
+            out.actor_out[0],
+            jax.tree.map(lambda x: x[0], out.carry)
+            if self._inner.recurrent
+            else out.carry,
+        )
+
+
+def flaky_engine_from_profile(
+    engine: Any,
+    profile: Dict[str, Any],
+    *,
+    sleep: Callable[[float], None] = None,
+) -> Any:
+    """Wrap ``engine`` per the parsed profile's serving clauses; an
+    inert profile returns the engine untouched, so the fast path stays
+    byte-for-byte the pre-chaos code path."""
+    plan = profile.get("serve_plan") or []
+    rate = float(profile.get("serve_rate") or 0.0)
+    if not plan and rate <= 0.0:
+        return engine
+    return FlakyEngine(
+        engine,
+        plan=plan,
+        failure_rate=rate,
+        seed=int(profile.get("seed", 0)),
+        sleep=sleep,
+    )
 
 
 class SimulatedPreemptionError(RuntimeError):
@@ -203,6 +341,8 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
 
         {"nan_bars": [...], "inf_bars": [...], "fields": [...],
          "transport_plan": [...], "transport_rate": float,
+         "serve_plan": [...], "serve_rate": float,
+         "burst": {"size": int, "rounds": int}|None,
          "preempt_at": int|None, "seed": int}
 
     Empty/None spec parses to an all-inert profile; unknown clause keys
@@ -214,6 +354,9 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
         "fields": ["close"],
         "transport_plan": [],
         "transport_rate": 0.0,
+        "serve_plan": [],
+        "serve_rate": 0.0,
+        "burst": None,
         "preempt_at": None,
         "seed": 0,
     }
@@ -243,6 +386,23 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
                 profile["transport_plan"] = [
                     t for t in val.replace("+", ",").split(",") if t
                 ]
+        elif key == "serve":
+            if val.startswith("p") and _is_float(val[1:]):
+                profile["serve_rate"] = float(val[1:])
+            else:
+                profile["serve_plan"] = [
+                    t for t in val.replace("+", ",").split(",") if t
+                ]
+        elif key == "burst":
+            size, _, rounds = val.partition("x")
+            profile["burst"] = {
+                "size": int(size),
+                "rounds": int(rounds) if rounds else 1,
+            }
+            if profile["burst"]["size"] < 1 or profile["burst"]["rounds"] < 1:
+                raise ValueError(
+                    f"burst clause must be NxK with N,K >= 1, got {val!r}"
+                )
         elif key == "preempt_at":
             profile["preempt_at"] = int(val)
         elif key == "seed":
@@ -250,7 +410,8 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
         else:
             raise ValueError(
                 f"unknown fault_profile key {key!r}; known: nan_bars, "
-                "inf_bars, fields, transport, preempt_at, seed"
+                "inf_bars, fields, transport, serve, burst, preempt_at, "
+                "seed"
             )
     return profile
 

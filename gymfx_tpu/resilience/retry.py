@@ -19,6 +19,7 @@ its injectable transport and the order router.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Any, Callable, NamedTuple, Optional
 
@@ -46,25 +47,33 @@ class RetryPolicy(NamedTuple):
 class RetryBudget:
     """Cross-call retry budget: a run-level cap on TOTAL retries so a
     systemically failing dependency degrades to fail-fast instead of
-    multiplying every call's latency by the per-call retry count."""
+    multiplying every call's latency by the per-call retry count.
+
+    Thread-safe: the budget is shared across concurrent callers (the
+    serving path fans requests out from many client threads), so
+    ``take`` must grant exactly ``max_retries`` tokens in total no
+    matter how many threads race it."""
 
     def __init__(self, max_retries: int = 64):
         if int(max_retries) < 0:
             raise ValueError(f"max_retries must be >= 0, got {max_retries}")
         self.max_retries = int(max_retries)
         self.used = 0
+        self._lock = threading.Lock()
 
     @property
     def remaining(self) -> int:
-        return max(0, self.max_retries - self.used)
+        with self._lock:
+            return max(0, self.max_retries - self.used)
 
     def take(self) -> bool:
         """Consume one retry token; False when the budget is exhausted
         (the caller must fail fast instead of retrying)."""
-        if self.used >= self.max_retries:
-            return False
-        self.used += 1
-        return True
+        with self._lock:
+            if self.used >= self.max_retries:
+                return False
+            self.used += 1
+            return True
 
 
 class RetryError(RuntimeError):
@@ -140,7 +149,13 @@ class CircuitBreaker:
     it and trips OPEN at ``failure_threshold`` (a half-open probe
     failure re-trips immediately).  ``on_trip`` fires on the CLOSED ->
     OPEN transition (not on half-open re-trips) — the live router uses
-    it to enter its flatten-and-halt degraded mode exactly once."""
+    it to enter its flatten-and-halt degraded mode exactly once.
+
+    Thread-safe: the serving path shares one breaker between the
+    batcher worker and any direct-dispatch callers, so transitions are
+    serialized under a lock.  ``on_trip`` fires OUTSIDE the lock (the
+    router's flatten hook makes venue calls; holding the breaker lock
+    across those would invite deadlock)."""
 
     def __init__(
         self,
@@ -164,9 +179,14 @@ class CircuitBreaker:
         self.trip_count = 0
         self._opened_at: Optional[float] = None
         self._probing = False
+        self._lock = threading.Lock()
 
     @property
     def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
         if self._opened_at is None:
             return "closed"
         if self._probing:
@@ -176,34 +196,39 @@ class CircuitBreaker:
         return "open"
 
     def allow(self) -> None:
-        if self._opened_at is None:
-            return
-        if self._probing:
-            # one probe is already in flight; refuse concurrent calls
-            raise CircuitOpenError(
-                "circuit breaker half-open: probe in flight"
-            )
-        elapsed = self._clock() - self._opened_at
-        if elapsed < self.recovery_time:
-            raise CircuitOpenError(
-                f"circuit breaker open after {self.failures} consecutive "
-                f"failures; retrying in "
-                f"{self.recovery_time - elapsed:.1f}s"
-            )
-        self._probing = True  # half-open: let exactly one probe through
+        with self._lock:
+            if self._opened_at is None:
+                return
+            if self._probing:
+                # one probe is already in flight; refuse concurrent calls
+                raise CircuitOpenError(
+                    "circuit breaker half-open: probe in flight"
+                )
+            elapsed = self._clock() - self._opened_at
+            if elapsed < self.recovery_time:
+                raise CircuitOpenError(
+                    f"circuit breaker open after {self.failures} consecutive "
+                    f"failures; retrying in "
+                    f"{self.recovery_time - elapsed:.1f}s"
+                )
+            self._probing = True  # half-open: let exactly one probe through
 
     def record_success(self) -> None:
-        self.failures = 0
-        self._opened_at = None
-        self._probing = False
+        with self._lock:
+            self.failures = 0
+            self._opened_at = None
+            self._probing = False
 
     def record_failure(self) -> None:
-        self.failures += 1
-        was_open = self._opened_at is not None
-        if self._probing or self.failures >= self.failure_threshold:
-            self._opened_at = self._clock()  # (re-)arm the recovery window
-            self._probing = False
-            if not was_open:
-                self.trip_count += 1
-                if self.on_trip is not None:
-                    self.on_trip()
+        fire_trip = False
+        with self._lock:
+            self.failures += 1
+            was_open = self._opened_at is not None
+            if self._probing or self.failures >= self.failure_threshold:
+                self._opened_at = self._clock()  # (re-)arm the recovery window
+                self._probing = False
+                if not was_open:
+                    self.trip_count += 1
+                    fire_trip = self.on_trip is not None
+        if fire_trip:
+            self.on_trip()

@@ -5,8 +5,18 @@ shape-bucketed forward pass (:mod:`engine`), a micro-batching scheduler
 coalescing concurrent requests into one dispatch (:mod:`batcher`), and
 a per-session O(1) featurizer producing observations bit-identical to
 the training env's (:mod:`features`)."""
-from gymfx_tpu.serve.batcher import MicroBatcher, RequestRecord
+from gymfx_tpu.serve.batcher import (
+    MicroBatcher,
+    RequestRecord,
+    batcher_from_config,
+)
 from gymfx_tpu.serve.config import ServeConfig, serve_config_from
+from gymfx_tpu.serve.overload import (
+    OVERLOAD_ERRORS,
+    BatcherClosedError,
+    DeadlineExceeded,
+    ShedError,
+)
 from gymfx_tpu.serve.engine import (
     DEFAULT_BUCKETS,
     Decision,
@@ -25,14 +35,19 @@ from gymfx_tpu.serve.features import (
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "OVERLOAD_ERRORS",
     "BarFeaturizer",
     "BarSession",
+    "BatcherClosedError",
+    "DeadlineExceeded",
     "Decision",
     "EngineBundle",
     "InferenceEngine",
     "MicroBatcher",
     "RequestRecord",
     "ServeConfig",
+    "ShedError",
+    "batcher_from_config",
     "engine_from_config",
     "flatten_obs_host",
     "make_host_encoder",

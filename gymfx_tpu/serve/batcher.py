@@ -1,5 +1,5 @@
 """Micro-batching scheduler: coalesce concurrent decide-action requests
-into one engine dispatch.
+into one engine dispatch, under admission control.
 
 Concurrent sessions (live instruments, replayed accounts, bench
 clients) each submit one encoded observation; a single worker thread
@@ -17,19 +17,41 @@ coalesces whatever arrives within a bounded window into one
   * responses are unpadded by the engine and resolved per-request
     through futures — a pad row has no future, so it can never leak.
 
+The overload contract (docs/serving.md, "Overload behavior"): every
+submitted request RESOLVES — with its Decision row, or with exactly one
+typed error from :mod:`gymfx_tpu.serve.overload`.  Admission control
+bounds the queue (``max_queue`` + ``shed_policy``); per-request
+deadlines fail a request fast at pickup or at dispatch instead of
+letting it occupy a batch slot it can no longer use; an optional
+:class:`~gymfx_tpu.resilience.retry.CircuitBreaker` around engine
+dispatch fails whole batches fast while the engine is down; and the
+worker SURVIVES dispatch exceptions — an engine fault resolves its
+batch's futures with the error and the queue keeps moving.  ``health()``
+exposes queue depth / oldest-request age / breaker state / counters,
+``drain()`` stops admissions and flushes, ``close()`` fails (never
+hangs) everything still queued.
+
 Per-request timing records (enqueue/pickup/dispatch/done) are kept for
 the latency satellites: tests/test_serve_batcher.py asserts the wait
 bound on them and bench_infer.py derives its p50/p99 from them.
 """
 from __future__ import annotations
 
-import queue
 import threading
 import time
-from concurrent.futures import Future
-from typing import Any, List, NamedTuple, Optional
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, Deque, Dict, List, NamedTuple, Optional
 
 import numpy as np
+
+from gymfx_tpu.resilience.retry import CircuitOpenError
+from gymfx_tpu.serve.overload import (
+    BatcherClosedError,
+    DeadlineExceeded,
+    ShedError,
+    resolve_shed_policy,
+)
 
 
 class RequestRecord(NamedTuple):
@@ -56,6 +78,7 @@ class _Pending(NamedTuple):
     carry: Any
     future: Future
     t_enqueue: float
+    deadline: Optional[float]  # absolute perf_counter second, None = no deadline
 
 
 class MicroBatcher:
@@ -63,7 +86,26 @@ class MicroBatcher:
 
     Use as a context manager or call :meth:`close`; ``submit`` returns a
     ``concurrent.futures.Future`` resolving to the request's
-    :class:`~gymfx_tpu.serve.engine.Decision` row.
+    :class:`~gymfx_tpu.serve.engine.Decision` row — or failing with one
+    of the typed overload errors (:mod:`gymfx_tpu.serve.overload`).
+
+    Overload knobs (all default OFF, preserving the unbounded pre-
+    admission behavior):
+
+    ``max_queue``            queue capacity; ``None`` = unbounded
+    ``shed_policy``          ``"reject"`` — a submit against a full
+        queue raises :class:`ShedError` immediately (backpressure lands
+        on the newest caller); ``"evict_oldest"`` — the oldest queued
+        request's future fails with ``ShedError(reason="evicted")`` and
+        the new request is admitted (freshest-data-wins, the right
+        policy when stale decisions are worthless anyway)
+    ``default_deadline_ms``  deadline applied to submits that do not
+        pass their own ``deadline_ms``
+    ``breaker``              a :class:`~gymfx_tpu.resilience.retry.
+        CircuitBreaker` gating engine dispatch: failures count toward
+        the trip threshold and an open breaker fails batches fast with
+        :class:`CircuitOpenError` instead of queueing behind a dead
+        engine
     """
 
     def __init__(
@@ -73,6 +115,10 @@ class MicroBatcher:
         max_batch_wait_ms: float = 2.0,
         max_batch: Optional[int] = None,
         keep_records: int = 100_000,
+        max_queue: Optional[int] = None,
+        shed_policy: str = "reject",
+        default_deadline_ms: Optional[float] = None,
+        breaker: Optional[Any] = None,
     ):
         if max_batch_wait_ms < 0:
             raise ValueError(
@@ -85,50 +131,177 @@ class MicroBatcher:
         )
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
-        self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.shed_policy = resolve_shed_policy(shed_policy)
+        if default_deadline_ms is not None and default_deadline_ms <= 0:
+            raise ValueError(
+                f"default_deadline_ms must be > 0, got {default_deadline_ms}"
+            )
+        self.default_deadline_ms = default_deadline_ms
+        self.breaker = breaker
+        self._pending: Deque[_Pending] = deque()
         self._records: List[RequestRecord] = []
         self._records_cap = int(keep_records)
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self.dispatches = 0
         self.coalesced_total = 0
+        self.shed_count = 0
+        self.deadline_miss_count = 0
+        self.dispatch_failures = 0
+        self.breaker_open_count = 0
+        self._inflight = 0
         self._closed = False
+        self._draining = False
+        self._stop = False
         self._worker = threading.Thread(
             target=self._run, name="gymfx-serve-batcher", daemon=True
         )
         self._worker.start()
 
     # ------------------------------------------------------------------
-    def submit(self, obs_row: Any, carry: Any = None) -> Future:
+    def submit(
+        self,
+        obs_row: Any,
+        carry: Any = None,
+        *,
+        deadline_ms: Optional[float] = None,
+    ) -> Future:
         """Enqueue one encoded observation (engine input row); returns a
         Future of its Decision row.  ``carry`` is the session's
         recurrent carry (required by recurrent engines; fresh sessions
-        pass ``engine.initial_carry()``)."""
-        if self._closed:
-            raise RuntimeError("MicroBatcher is closed")
+        pass ``engine.initial_carry()``).  ``deadline_ms`` bounds how
+        long the request may wait end-to-end (defaults to the batcher's
+        ``default_deadline_ms``); a request whose deadline passes before
+        dispatch fails with :class:`DeadlineExceeded`.
+
+        Raises :class:`BatcherClosedError` after close()/drain(), and
+        :class:`ShedError` when the queue is full under the ``reject``
+        shed policy (under ``evict_oldest`` the OLDEST queued request's
+        future fails instead and this one is admitted)."""
         if self.engine.recurrent and carry is None:
             carry = self.engine.initial_carry()
-        fut: Future = Future()
-        self._queue.put(
-            _Pending(
-                np.asarray(obs_row, self.engine.obs_dtype),
-                carry,
-                fut,
-                time.perf_counter(),
-            )
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        t_enqueue = time.perf_counter()
+        pending = _Pending(
+            np.asarray(obs_row, self.engine.obs_dtype),
+            carry,
+            Future(),
+            t_enqueue,
+            None if deadline_ms is None else t_enqueue + float(deadline_ms) / 1e3,
         )
-        return fut
+        evicted: Optional[_Pending] = None
+        with self._cv:
+            if self._closed:
+                raise BatcherClosedError("MicroBatcher is closed")
+            if self._draining:
+                raise BatcherClosedError(
+                    "MicroBatcher is draining: admissions closed"
+                )
+            if (
+                self.max_queue is not None
+                and len(self._pending) >= self.max_queue
+            ):
+                self.shed_count += 1
+                if self.shed_policy == "evict_oldest":
+                    evicted = self._pending.popleft()
+                else:
+                    raise ShedError(
+                        f"request queue full ({self.max_queue}); request "
+                        "rejected (shed_policy=reject)",
+                        reason="queue_full",
+                    )
+            self._pending.append(pending)
+            self._cv.notify_all()
+        if evicted is not None:
+            _resolve_exc(
+                evicted.future,
+                ShedError(
+                    f"evicted from a full queue ({self.max_queue}) by a "
+                    "newer request (shed_policy=evict_oldest)",
+                    reason="evicted",
+                ),
+            )
+        return pending.future
 
     @property
     def records(self) -> List[RequestRecord]:
-        with self._lock:
+        with self._cv:
             return list(self._records)
 
+    def health(self) -> Dict[str, Any]:
+        """Point-in-time serving health: queue pressure, breaker state
+        and the overload counters (the live supervisor's poll surface;
+        bench_infer.py snapshots it after the chaos scenario)."""
+        now = time.perf_counter()
+        with self._cv:
+            return {
+                "queue_depth": len(self._pending),
+                "inflight_requests": self._inflight,
+                "oldest_request_age_s": (
+                    now - self._pending[0].t_enqueue if self._pending else 0.0
+                ),
+                "breaker_state": (
+                    None if self.breaker is None else self.breaker.state
+                ),
+                "shed_count": self.shed_count,
+                "deadline_miss_count": self.deadline_miss_count,
+                "dispatch_failures": self.dispatch_failures,
+                "breaker_open_failures": self.breaker_open_count,
+                "dispatches": self.dispatches,
+                "coalesced_total": self.coalesced_total,
+                "max_queue": self.max_queue,
+                "draining": self._draining,
+                "closed": self._closed,
+            }
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown, phase 1: stop admissions (submit raises
+        :class:`BatcherClosedError`) and wait for the queued + in-flight
+        work to flush through the engine.  Returns True when fully
+        drained within ``timeout`` seconds (None = wait forever); the
+        caller then calls :meth:`close` for phase 2."""
+        end = None if timeout is None else time.perf_counter() + timeout
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+            while self._pending or self._inflight:
+                if self._stop:
+                    break
+                if end is None:
+                    self._cv.wait()
+                else:
+                    remaining = end - time.perf_counter()
+                    if remaining <= 0:
+                        return False
+                    self._cv.wait(remaining)
+            return not self._pending and not self._inflight
+
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        self._queue.put(None)
+        """Stop the worker and FAIL every request still queued with
+        :class:`BatcherClosedError` — a closed batcher never leaves a
+        caller blocked on ``future.result()``.  Bounded by at most one
+        in-flight dispatch; idempotent."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._stop = True
+            self._cv.notify_all()
         self._worker.join()
+        with self._cv:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for p in leftovers:
+            _resolve_exc(
+                p.future,
+                BatcherClosedError(
+                    "MicroBatcher closed with the request still queued"
+                ),
+            )
 
     def __enter__(self) -> "MicroBatcher":
         return self
@@ -137,35 +310,106 @@ class MicroBatcher:
         self.close()
 
     # ------------------------------------------------------------------
+    def _take(self, timeout: Optional[float]) -> Optional[_Pending]:
+        """Pop the oldest LIVE request; requests already past their
+        deadline are failed here (the pickup check) and skipped.
+        Returns None on stop or timeout."""
+        end = None if timeout is None else time.perf_counter() + timeout
+        while True:
+            expired: Optional[_Pending] = None
+            with self._cv:
+                while True:
+                    if self._stop:
+                        return None
+                    if self._pending:
+                        break
+                    if end is None:
+                        self._cv.wait()
+                    else:
+                        remaining = end - time.perf_counter()
+                        if remaining <= 0:
+                            return None
+                        self._cv.wait(remaining)
+                p = self._pending.popleft()
+                self._cv.notify_all()
+                if (
+                    p.deadline is not None
+                    and time.perf_counter() > p.deadline
+                ):
+                    self.deadline_miss_count += 1
+                    expired = p
+                else:
+                    return p
+            _resolve_exc(
+                expired.future,
+                DeadlineExceeded(
+                    "deadline passed while queued (expired at pickup)",
+                    phase="pickup",
+                ),
+            )
+
     def _run(self) -> None:
         while True:
-            first = self._queue.get()
-            if first is None:
+            first = self._take(None)
+            if first is None:  # stop requested; close() fails the rest
                 return
-            t_pickup = time.perf_counter()
-            batch = [first]
-            deadline = t_pickup + self.max_batch_wait_ms / 1000.0
-            stop = False
-            while len(batch) < self.max_batch:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    stop = True
-                    break
-                batch.append(nxt)
-            self._dispatch(batch, t_pickup)
-            if stop:
-                return
+            with self._cv:
+                self._inflight += 1
+            try:
+                t_pickup = time.perf_counter()
+                batch = [first]
+                window_end = t_pickup + self.max_batch_wait_ms / 1000.0
+                while len(batch) < self.max_batch:
+                    remaining = window_end - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    nxt = self._take(remaining)
+                    if nxt is None:  # window closed (or stop: seen above)
+                        break
+                    batch.append(nxt)
+                # dispatch-time deadline check: a request that expired
+                # while the window was open must not occupy a batch slot
+                now = time.perf_counter()
+                live: List[_Pending] = []
+                n_expired = 0
+                for p in batch:
+                    if p.deadline is not None and now > p.deadline:
+                        n_expired += 1
+                        _resolve_exc(
+                            p.future,
+                            DeadlineExceeded(
+                                "deadline passed inside the batching "
+                                "window (expired at dispatch)",
+                                phase="dispatch",
+                            ),
+                        )
+                    else:
+                        live.append(p)
+                if n_expired:
+                    with self._cv:
+                        self.deadline_miss_count += n_expired
+                if live:
+                    self._dispatch(live, t_pickup)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
 
     def _dispatch(self, batch: List[_Pending], t_pickup: float) -> None:
         import jax
 
         n = len(batch)
+        if self.breaker is not None:
+            try:
+                self.breaker.allow()
+            except CircuitOpenError as exc:
+                # fail fast while the engine is (presumed) down — the
+                # queue must not build behind a dead dependency
+                with self._cv:
+                    self.breaker_open_count += n
+                for p in batch:
+                    _resolve_exc(p.future, exc)
+                return
         obs = np.stack([p.obs for p in batch])
         carries = (
             jax.tree.map(lambda *xs: np.stack(xs), *[p.carry for p in batch])
@@ -175,14 +419,24 @@ class MicroBatcher:
         t_dispatch = time.perf_counter()
         try:
             out = self.engine.decide_batch(obs, carries)
-        except BaseException as exc:  # resolve every waiter, then rethrow
+        except BaseException as exc:
+            # resolve every waiter with the fault and KEEP SERVING: one
+            # poisoned dispatch must not stall the whole queue (the
+            # breaker is what escalates repeated failures)
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            with self._cv:
+                self.dispatch_failures += 1
             for p in batch:
-                p.future.set_exception(exc)
-            raise
+                _resolve_exc(p.future, exc)
+            return
+        if self.breaker is not None:
+            self.breaker.record_success()
         t_done = time.perf_counter()
         bucket = self.engine.bucket_for(n)
         for i, p in enumerate(batch):
-            p.future.set_result(
+            _resolve_result(
+                p.future,
                 type(out)(
                     out.action[i],
                     out.value[i],
@@ -190,9 +444,9 @@ class MicroBatcher:
                     jax.tree.map(lambda x: x[i], out.carry)
                     if self.engine.recurrent
                     else out.carry,
-                )
+                ),
             )
-        with self._lock:
+        with self._cv:
             self.dispatches += 1
             self.coalesced_total += n
             if len(self._records) + n <= self._records_cap:
@@ -202,3 +456,43 @@ class MicroBatcher:
                     )
                     for p in batch
                 )
+
+
+def _resolve_exc(future: Future, exc: BaseException) -> None:
+    try:
+        future.set_exception(exc)
+    except InvalidStateError:  # caller cancelled the future; nothing owed
+        pass
+
+
+def _resolve_result(future: Future, result: Any) -> None:
+    try:
+        future.set_result(result)
+    except InvalidStateError:
+        pass
+
+
+def batcher_from_config(engine, config) -> MicroBatcher:
+    """Build an admission-controlled batcher from the merged config dict
+    (or an already-parsed :class:`~gymfx_tpu.serve.config.ServeConfig`),
+    including the serving circuit breaker when
+    ``serve_breaker_threshold`` > 0 — the one construction path shared
+    by the live wiring and bench_infer.py's chaos scenario."""
+    from gymfx_tpu.serve.config import ServeConfig, serve_config_from
+
+    scfg = config if isinstance(config, ServeConfig) else serve_config_from(config)
+    breaker = None
+    if scfg.breaker_threshold:
+        from gymfx_tpu.resilience.retry import CircuitBreaker
+
+        breaker = CircuitBreaker(
+            scfg.breaker_threshold, scfg.breaker_recovery_s
+        )
+    return MicroBatcher(
+        engine,
+        max_batch_wait_ms=scfg.max_batch_wait_ms,
+        max_queue=scfg.max_queue,
+        shed_policy=scfg.shed_policy,
+        default_deadline_ms=scfg.deadline_ms,
+        breaker=breaker,
+    )

@@ -1,11 +1,16 @@
 """Serving config surface: the ``serve_*`` keys (config/defaults.py)
 parsed into one immutable struct shared by the engine constructor, the
-live decision service (live/oanda.py) and bench_infer.py."""
+micro-batcher, the live decision service (live/oanda.py) and
+bench_infer.py."""
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 from gymfx_tpu.serve.engine import DEFAULT_BUCKETS
+from gymfx_tpu.serve.overload import (
+    resolve_fallback_policy,
+    resolve_shed_policy,
+)
 
 
 class ServeConfig(NamedTuple):
@@ -13,6 +18,14 @@ class ServeConfig(NamedTuple):
     max_batch_wait_ms: float
     batch_mode: str   # auto | exact | matmul (engine.resolve_batch_mode)
     warmup: bool
+    # ---- overload resilience (docs/serving.md, "Overload behavior") ----
+    max_queue: Optional[int]          # admission queue capacity; None = unbounded
+    shed_policy: str                  # reject | evict_oldest
+    deadline_ms: Optional[float]      # per-request deadline; None = none
+    fallback: str                     # hold | flat | reject (live degraded mode)
+    breaker_threshold: int            # dispatch failures to trip; 0 = no breaker
+    breaker_recovery_s: float         # open -> half-open window
+    feed_stale_after_s: Optional[float]  # live stale-feed watchdog; None = off
 
 
 def _parse_buckets(value: Any) -> Tuple[int, ...]:
@@ -38,13 +51,44 @@ def _parse_buckets(value: Any) -> Tuple[int, ...]:
     return tuple(sorted({int(b) for b in value}))
 
 
+def _opt_positive(config: Dict[str, Any], key: str, kind=float) -> Optional[Any]:
+    """None/0/"" -> None (feature off); otherwise a positive number."""
+    raw = config.get(key)
+    if raw is None or raw == "" or (isinstance(raw, (int, float)) and raw <= 0):
+        if isinstance(raw, (int, float)) and raw < 0:
+            raise ValueError(f"{key} must be > 0 (or null to disable), got {raw}")
+        return None
+    return kind(raw)
+
+
 def serve_config_from(config: Dict[str, Any]) -> ServeConfig:
     wait = float(config.get("serve_max_batch_wait_ms", 2.0) or 0.0)
     if wait < 0:
         raise ValueError(f"serve_max_batch_wait_ms must be >= 0, got {wait}")
+    threshold = int(config.get("serve_breaker_threshold", 5) or 0)
+    if threshold < 0:
+        raise ValueError(
+            f"serve_breaker_threshold must be >= 0 (0 disables), got {threshold}"
+        )
+    recovery = float(config.get("serve_breaker_recovery_s", 5.0) or 0.0)
+    if recovery < 0:
+        raise ValueError(
+            f"serve_breaker_recovery_s must be >= 0, got {recovery}"
+        )
     return ServeConfig(
         buckets=_parse_buckets(config.get("serve_buckets")),
         max_batch_wait_ms=wait,
         batch_mode=str(config.get("serve_batch_mode", "auto") or "auto"),
         warmup=bool(config.get("serve_warmup", True)),
+        max_queue=_opt_positive(config, "serve_max_queue", int),
+        shed_policy=resolve_shed_policy(
+            str(config.get("serve_shed_policy", "reject") or "reject")
+        ),
+        deadline_ms=_opt_positive(config, "serve_deadline_ms", float),
+        fallback=resolve_fallback_policy(
+            str(config.get("serve_fallback", "hold") or "hold")
+        ),
+        breaker_threshold=threshold,
+        breaker_recovery_s=recovery,
+        feed_stale_after_s=_opt_positive(config, "feed_stale_after_s", float),
     )

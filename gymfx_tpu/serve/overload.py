@@ -1,0 +1,92 @@
+"""Typed overload outcomes for the serving path (docs/serving.md,
+"Overload behavior").
+
+Every request submitted to the :class:`~gymfx_tpu.serve.batcher.
+MicroBatcher` resolves — with a Decision row on the fast path, or with
+exactly one of these typed errors on the brownout path.  Nothing here
+is retried silently and no future is ever left hanging; callers (the
+live :class:`~gymfx_tpu.live.oanda.PolicyDecisionService`, bench
+clients) branch on the type to pick a degraded-mode fallback.
+
+  ShedError           admission control refused the request: the
+                      bounded queue was full and the shed policy either
+                      rejected this (newest) request or evicted the
+                      oldest one to admit it;
+  DeadlineExceeded    the request's ``deadline_ms`` passed before the
+                      engine could serve it (checked when the worker
+                      picks it up AND again just before dispatch, so an
+                      expired request never occupies a batch slot);
+  BatcherClosedError  the batcher was closed/draining — at submit time
+                      (admission refused) or with the request still
+                      queued (its future fails instead of hanging).
+
+``OVERLOAD_ERRORS`` additionally includes
+:class:`~gymfx_tpu.resilience.retry.CircuitOpenError`: a serving
+breaker that tripped on repeated dispatch failures fails requests fast
+with it, and the live fallback policy treats it as one more overload
+signal.
+"""
+from __future__ import annotations
+
+from gymfx_tpu.resilience.retry import CircuitOpenError
+
+FALLBACK_POLICIES = ("hold", "flat", "reject")
+SHED_POLICIES = ("reject", "evict_oldest")
+
+
+class ShedError(RuntimeError):
+    """Admission control shed this request (queue at capacity).
+
+    ``reason`` is ``"queue_full"`` (reject-newest refused the submit)
+    or ``"evicted"`` (an older queued request was dropped to admit a
+    newer one)."""
+
+    def __init__(self, message: str, reason: str = "queue_full"):
+        super().__init__(message)
+        self.reason = reason
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before it could be served.
+
+    ``phase`` records where the miss was detected: ``"pickup"`` (the
+    worker popped an already-expired request) or ``"dispatch"`` (it
+    expired while the batching window was open)."""
+
+    def __init__(self, message: str, phase: str = "pickup"):
+        super().__init__(message)
+        self.phase = phase
+
+
+class BatcherClosedError(RuntimeError):
+    """The batcher is closed (or draining): new submissions are refused
+    and requests still queued at close resolve with this instead of
+    hanging forever."""
+
+
+def resolve_fallback_policy(policy: str) -> str:
+    if policy not in FALLBACK_POLICIES:
+        raise ValueError(
+            f"serve_fallback must be one of {FALLBACK_POLICIES}, "
+            f"got {policy!r}"
+        )
+    return policy
+
+
+def resolve_shed_policy(policy: str) -> str:
+    if policy not in SHED_POLICIES:
+        raise ValueError(
+            f"serve_shed_policy must be one of {SHED_POLICIES}, "
+            f"got {policy!r}"
+        )
+    return policy
+
+
+# the full set a serving client must be prepared to catch: every shed /
+# expired / closed / breaker-open request resolves with one of these
+OVERLOAD_ERRORS = (
+    ShedError,
+    DeadlineExceeded,
+    BatcherClosedError,
+    CircuitOpenError,
+)

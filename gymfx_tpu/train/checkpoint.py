@@ -16,11 +16,23 @@ Zero-size leaves (e.g. a (N, W, 0) feature window when no feature
 columns are configured) cannot be stored by orbax; they are masked with
 a placeholder at save and rebuilt at load — from the template when one
 is given, else from the ``empty_leaves_<step>.json`` sidecar.
+
+Integrity: every save writes a ``digest_<step>.json`` sidecar holding a
+sha256 over the step directory's file names and bytes (and all JSON
+sidecars are written atomically: tmp file + ``os.replace``).  A restore
+verifies the digest first; a torn or bit-rotted step is logged loudly
+and skipped in favor of the newest step that still verifies.  Steps
+without a digest sidecar (saves predating this format) are accepted
+unchanged.
 """
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import math
+import os
+import tempfile
 import warnings
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
@@ -28,6 +40,84 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
+
+
+def _atomic_write_text(target: Path, text: str) -> None:
+    """Write-then-rename so a crash mid-write can never leave a torn
+    sidecar next to a valid checkpoint (os.replace is atomic on POSIX
+    within one filesystem, and the tmp file lives in the target dir)."""
+    fd, tmp = tempfile.mkstemp(
+        dir=str(target.parent), prefix=f".{target.name}.", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _digest_step_dir(path: Path, step: int) -> Optional[Dict[str, Any]]:
+    """sha256 over the step directory's sorted relative file names and
+    contents — torn/partial files change the digest directly, with no
+    dependency on orbax's restore or casting semantics."""
+    step_dir = path / str(int(step))
+    if not step_dir.is_dir():
+        return None
+    h = hashlib.sha256()
+    n_files = 0
+    for f in sorted(p for p in step_dir.rglob("*") if p.is_file()):
+        h.update(str(f.relative_to(step_dir)).encode())
+        h.update(b"\0")
+        with f.open("rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        h.update(b"\0")
+        n_files += 1
+    return {"algo": "sha256", "digest": h.hexdigest(), "files": n_files}
+
+
+def _digest_sidecar(path: Path, step: int) -> Path:
+    return path / f"digest_{int(step)}.json"
+
+
+def verify_checkpoint_step(directory: str, step: int) -> bool:
+    """Recompute the step directory's digest against its sidecar.
+
+    True when they match or when no sidecar exists (legacy saves carry
+    no digest and are accepted); False — with a loud log — on any
+    mismatch, including a recorded digest whose step dir is gone."""
+    path = Path(directory).resolve()
+    sidecar = _digest_sidecar(path, step)
+    if not sidecar.exists():
+        return True
+    try:
+        recorded = json.loads(sidecar.read_text())
+    except (OSError, ValueError) as exc:
+        logger.error(
+            "checkpoint step %d under %s has an unreadable digest sidecar "
+            "(%s); treating the step as corrupt", step, path, exc,
+        )
+        return False
+    actual = _digest_step_dir(path, step)
+    if actual is None or actual["digest"] != recorded.get("digest"):
+        logger.error(
+            "checkpoint step %d under %s FAILED integrity verification "
+            "(stored sha256 %s, recomputed %s) — the step is torn or "
+            "bit-rotted and will be skipped",
+            step, path, recorded.get("digest"),
+            actual["digest"] if actual else "<step dir missing>",
+        )
+        return False
+    return True
 
 
 def _is_empty(x: Any) -> bool:
@@ -124,11 +214,18 @@ def save_checkpoint(
             mngr.save(int(step), args=ocp.args.StandardSave(_mask_empty(tree)))
         mngr.wait_until_finished()
     if any(empties.values()):
-        (path / f"empty_leaves_{int(step)}.json").write_text(
-            json.dumps(empties)
+        _atomic_write_text(
+            path / f"empty_leaves_{int(step)}.json", json.dumps(empties)
+        )
+    digest = _digest_step_dir(path, int(step))
+    if digest is not None:
+        _atomic_write_text(
+            _digest_sidecar(path, int(step)), json.dumps(digest)
         )
     if metadata is not None:
-        (path / "metadata.json").write_text(json.dumps(metadata, indent=2))
+        _atomic_write_text(
+            path / "metadata.json", json.dumps(metadata, indent=2)
+        )
     return str(path)
 
 
@@ -346,9 +443,28 @@ def _restore_item(
 ) -> Tuple[Any, int]:
     path = Path(directory).resolve()
     with ocp.CheckpointManager(path) as mngr:
-        step = mngr.latest_step()
-        if step is None:
+        steps = sorted(int(s) for s in mngr.all_steps())
+        if not steps:
             raise FileNotFoundError(f"no checkpoint found under {path}")
+        # newest step whose content digest still verifies; a torn latest
+        # step falls back to the previous valid one instead of feeding a
+        # half-written tree into the restore
+        step = next(
+            (s for s in reversed(steps) if verify_checkpoint_step(path, s)),
+            None,
+        )
+        if step is None:
+            raise RuntimeError(
+                f"every checkpoint step under {path} failed integrity "
+                f"verification (steps checked: {steps}); refusing to "
+                "restore corrupt state"
+            )
+        if step != steps[-1]:
+            logger.error(
+                "restoring checkpoint step %d under %s — newer step(s) "
+                "%s failed integrity verification",
+                step, path, [s for s in steps if s > step],
+            )
         if item is not None:
             args = (
                 ocp.args.StandardRestore(_mask_empty(template))
@@ -363,7 +479,25 @@ def _restore_item(
                 step, args=ocp.args.StandardRestore(_mask_empty(template))
             )
         else:
-            restored = mngr.restore(step)
+            # argless raw restore: newer orbax refuses to infer handlers
+            # for stored items, so name them explicitly from the step
+            # directory's item subdirs ("default" = single-item save)
+            items = sorted(
+                p.name
+                for p in (path / str(step)).iterdir()
+                if p.is_dir() and not p.name.startswith("_")
+            )
+            if items == ["default"] or not items:
+                restored = mngr.restore(
+                    step, args=ocp.args.StandardRestore()
+                )
+            else:
+                restored = mngr.restore(
+                    step,
+                    args=ocp.args.Composite(
+                        **{n: ocp.args.StandardRestore() for n in items}
+                    ),
+                )
     if template is not None:
         restored = _unmask_empty(template, restored)
     else:

@@ -35,3 +35,18 @@ def test_bench_infer_quick_prints_single_json_line_contract():
     # the whole point of the engine: the warm boot absorbed every
     # compile, the serving path never traced
     assert payload["late_compiles"] == 0
+    # serving SLO contract (docs/serving.md, Overload behavior): the
+    # line always carries the overload trio, and the scripted seeded
+    # burst-overload scenario must measurably engage the admission
+    # control — a scenario that sheds nothing measures nothing
+    for key in ("shed_rate", "deadline_miss_rate", "overload"):
+        assert key in payload, (key, payload)
+    over = payload["overload"]
+    assert over["submitted"] == (
+        over["served"] + over["shed"] + over["deadline_missed"]
+        + over["failed"]
+    )
+    assert payload["shed_rate"] > 0, over
+    assert payload["deadline_miss_rate"] > 0, over
+    assert over["served"] > 0, over
+    assert over["p99_ms"] > 0, over

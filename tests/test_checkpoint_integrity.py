@@ -1,0 +1,103 @@
+"""Checkpoint integrity (train/checkpoint.py): atomic sidecar writes,
+the sha256 step-dir digest, and the torn-checkpoint fallback — a
+corrupted latest step must be detected and skipped for the newest step
+that still verifies, never silently restored."""
+import json
+import logging
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from gymfx_tpu.train.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+    verify_checkpoint_step,
+)
+
+
+def _tree(seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.standard_normal((4, 3)).astype(np.float32),
+        "b": rng.standard_normal(3).astype(np.float32),
+    }
+
+
+def _corrupt_one_file(ckpt: Path, step: int) -> Path:
+    """Flip bytes in the largest file of the step dir (the array data —
+    a torn write lands there, not in orbax's tiny metadata)."""
+    files = sorted(
+        (p for p in (ckpt / str(step)).rglob("*") if p.is_file()),
+        key=lambda p: p.stat().st_size,
+    )
+    victim = files[-1]
+    data = bytearray(victim.read_bytes())
+    data[: max(1, len(data) // 2)] = b"\xff" * max(1, len(data) // 2)
+    victim.write_bytes(bytes(data))
+    return victim
+
+
+def test_save_writes_digest_and_verify_roundtrips(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(0), step=1)
+    sidecar = tmp_path / "ckpt" / "digest_1.json"
+    assert sidecar.exists()
+    recorded = json.loads(sidecar.read_text())
+    assert recorded["algo"] == "sha256" and recorded["files"] > 0
+    assert verify_checkpoint_step(d, 1) is True
+    # no leftover tmp files from the atomic write-then-rename
+    assert not list((tmp_path / "ckpt").glob("*.tmp"))
+    restored, step = load_checkpoint(d, template=_tree(0))
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], _tree(0)["w"])
+
+
+def test_torn_step_falls_back_to_previous_valid(tmp_path, caplog):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(1), step=1)
+    save_checkpoint(d, _tree(2), step=2)
+    _corrupt_one_file(tmp_path / "ckpt", 2)
+    assert verify_checkpoint_step(d, 2) is False
+    assert verify_checkpoint_step(d, 1) is True
+    with caplog.at_level(logging.ERROR, "gymfx_tpu.train.checkpoint"):
+        restored, step = load_checkpoint(d, template=_tree(1))
+    assert step == 1  # the torn step 2 was skipped, loudly
+    np.testing.assert_array_equal(restored["w"], _tree(1)["w"])
+    assert any("integrity" in r.message for r in caplog.records)
+
+
+def test_every_step_torn_refuses_to_restore(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(3), step=1)
+    _corrupt_one_file(tmp_path / "ckpt", 1)
+    with pytest.raises(RuntimeError, match="integrity"):
+        load_checkpoint(d, template=_tree(3))
+
+
+def test_legacy_checkpoint_without_digest_is_accepted(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(4), step=5)
+    (tmp_path / "ckpt" / "digest_5.json").unlink()  # pre-digest save
+    restored, step = load_checkpoint(d, template=_tree(4))
+    assert step == 5
+    np.testing.assert_array_equal(restored["b"], _tree(4)["b"])
+
+
+def test_unreadable_digest_sidecar_counts_as_corrupt(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(5), step=1)
+    save_checkpoint(d, _tree(6), step=2)
+    (tmp_path / "ckpt" / "digest_2.json").write_text("{not json")
+    assert verify_checkpoint_step(d, 2) is False
+    _restored, step = load_checkpoint(d, template=_tree(5))
+    assert step == 1
+
+
+def test_composite_save_digest_covers_both_items(tmp_path):
+    d = str(tmp_path / "ckpt")
+    state = {"params": _tree(7), "opt_state": _tree(8)}
+    save_checkpoint(d, state, step=3, params=state["params"])
+    assert verify_checkpoint_step(d, 3) is True
+    _corrupt_one_file(tmp_path / "ckpt", 3)
+    assert verify_checkpoint_step(d, 3) is False

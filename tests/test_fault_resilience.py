@@ -153,6 +153,92 @@ def test_circuit_breaker_lifecycle_and_on_trip_once():
     assert br.state == "closed" and br.failures == 0
 
 
+def test_half_open_probe_recloses_then_full_lifecycle_can_retrip():
+    clock = {"t": 0.0}
+    trips = []
+    br = CircuitBreaker(
+        failure_threshold=2, recovery_time=5.0,
+        clock=lambda: clock["t"], on_trip=lambda: trips.append(clock["t"]),
+    )
+    br.record_failure()
+    br.record_failure()  # open at t=0
+    clock["t"] = 5.0
+    br.allow()  # half-open probe
+    br.record_success()  # re-close
+    assert br.state == "closed" and br.failures == 0
+    # a RE-CLOSED breaker is a first-class closed breaker: a fresh
+    # failure streak trips it again and on_trip fires again
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "open"
+    assert br.trip_count == 2 and trips == [0.0, 5.0]
+    # and a failed probe after THIS trip re-opens without a third trip
+    clock["t"] = 10.0
+    br.allow()
+    br.record_failure()
+    assert br.state == "open" and br.trip_count == 2
+    with pytest.raises(CircuitOpenError):
+        br.allow()  # the re-opened window is re-armed from t=10
+    clock["t"] = 15.0
+    br.allow()
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_retry_budget_exhaustion_is_exact_under_concurrent_callers():
+    import threading
+
+    budget = RetryBudget(max_retries=50)
+    granted = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()  # maximal contention on take()
+        got = 0
+        for _ in range(20):
+            if budget.take():
+                got += 1
+        granted.append(got)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 8 threads x 20 wants = 160 asks against a budget of 50: EXACTLY
+    # 50 tokens granted in total — a race that double-grants would
+    # multiply a dead dependency's retry load instead of capping it
+    assert sum(granted) == 50
+    assert budget.remaining == 0
+    assert budget.take() is False
+
+
+def test_circuit_breaker_trips_exactly_once_under_concurrent_failures():
+    import threading
+
+    trips = []
+    br = CircuitBreaker(
+        failure_threshold=4, recovery_time=60.0,
+        on_trip=lambda: trips.append(threading.get_ident()),
+    )
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        for _ in range(10):
+            br.record_failure()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 80 concurrent failures, ONE closed->open transition: the live
+    # router's flatten-and-halt hook must fire exactly once
+    assert br.trip_count == 1 and len(trips) == 1
+    assert br.state == "open"
+
+
 # ---------------------------------------------------------------------------
 # pillar 3 unit: fault-injection harness
 # ---------------------------------------------------------------------------

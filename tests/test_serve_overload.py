@@ -1,0 +1,479 @@
+"""Serving-path overload resilience (gymfx_tpu/serve/overload.py).
+
+The overload contract: every submitted request RESOLVES — with its
+Decision or with exactly one typed error — under queue sheds, deadline
+expiry, breaker trips, dispatch faults and close().  The live
+PolicyDecisionService degrades to its configured fallback policy (and
+tags every synthetic decision) instead of surfacing raw errors.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gymfx_tpu.resilience.faults import (
+    FlakyEngine,
+    InjectedDispatchError,
+    flaky_engine_from_profile,
+    parse_fault_profile,
+)
+from gymfx_tpu.resilience.retry import CircuitBreaker, CircuitOpenError
+from gymfx_tpu.serve.batcher import MicroBatcher, batcher_from_config
+from gymfx_tpu.serve.engine import Decision
+from gymfx_tpu.serve.overload import (
+    BatcherClosedError,
+    DeadlineExceeded,
+    ShedError,
+    resolve_fallback_policy,
+    resolve_shed_policy,
+)
+
+OBS_DIM = 6
+
+
+class FakeEngine:
+    """Deterministic batcher test double: action = row index, value =
+    row sum (so responses are attributable per request); ``gate`` blocks
+    dispatch until released and ``fail`` raises, so queue states are
+    reproducible without timing races."""
+
+    recurrent = False
+    obs_dtype = np.float32
+    buckets = (1, 8)
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.fail_next = 0
+        self.dispatch_count = 0
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def initial_carry(self):
+        return None
+
+    def decide_batch(self, obs, carries=None):
+        self.dispatch_count += 1
+        self.gate.wait(timeout=30)
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("injected engine fault")
+        n = len(obs)
+        return Decision(
+            np.arange(n, dtype=np.int32),
+            np.asarray(obs).sum(axis=1).astype(np.float32),
+            np.zeros(n, np.float32),
+            (),
+        )
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (n, OBS_DIM)
+    ).astype(np.float32)
+
+
+def _blocked_batcher(**kw):
+    """Batcher whose FIRST dispatch is held at the engine gate, so the
+    queue behind it can be shaped deterministically."""
+    eng = FakeEngine()
+    eng.gate.clear()
+    mb = MicroBatcher(eng, max_batch_wait_ms=0.0, **kw)
+    f0 = mb.submit(_rows(1)[0])  # occupies the worker at the gate
+    deadline = time.perf_counter() + 5.0
+    while eng.dispatch_count == 0:  # wait until the worker is IN dispatch
+        if time.perf_counter() > deadline:
+            raise AssertionError("worker never reached dispatch")
+        time.sleep(0.001)
+    return eng, mb, f0
+
+
+def test_reject_policy_sheds_newest_with_typed_error():
+    eng, mb, f0 = _blocked_batcher(max_queue=2)
+    rows = _rows(3, seed=1)
+    f1 = mb.submit(rows[0])
+    f2 = mb.submit(rows[1])
+    with pytest.raises(ShedError) as exc:
+        mb.submit(rows[2])  # queue is at capacity: newest is rejected
+    assert exc.value.reason == "queue_full"
+    eng.gate.set()
+    # every ADMITTED request still resolves normally
+    for f in (f0, f1, f2):
+        assert isinstance(f.result(timeout=30), Decision)
+    health = mb.health()
+    assert health["shed_count"] == 1
+    mb.close()
+
+
+def test_evict_oldest_fails_the_victims_future():
+    eng, mb, f0 = _blocked_batcher(max_queue=2, shed_policy="evict_oldest")
+    rows = _rows(3, seed=2)
+    f1 = mb.submit(rows[0])
+    f2 = mb.submit(rows[1])
+    f3 = mb.submit(rows[2])  # admitted; f1 (oldest queued) is evicted
+    with pytest.raises(ShedError) as exc:
+        f1.result(timeout=30)
+    assert exc.value.reason == "evicted"
+    eng.gate.set()
+    assert isinstance(f2.result(timeout=30), Decision)
+    assert isinstance(f3.result(timeout=30), Decision)
+    assert mb.shed_count == 1
+    mb.close()
+
+
+def test_deadline_expires_at_pickup_while_queued():
+    eng, mb, f0 = _blocked_batcher()
+    f1 = mb.submit(_rows(1, seed=3)[0], deadline_ms=1.0)
+    time.sleep(0.03)  # the deadline passes while f1 waits in the queue
+    eng.gate.set()
+    with pytest.raises(DeadlineExceeded) as exc:
+        f1.result(timeout=30)
+    assert exc.value.phase == "pickup"
+    assert isinstance(f0.result(timeout=30), Decision)
+    assert mb.deadline_miss_count == 1
+    mb.close()
+
+
+def test_deadline_expires_inside_the_batching_window():
+    # a LONG coalescing window and a deadline shorter than it: the lone
+    # request is live at pickup but expired by dispatch time
+    eng = FakeEngine()
+    with MicroBatcher(eng, max_batch_wait_ms=150.0, max_batch=8) as mb:
+        fut = mb.submit(_rows(1, seed=4)[0], deadline_ms=25.0)
+        with pytest.raises(DeadlineExceeded) as exc:
+            fut.result(timeout=30)
+        assert exc.value.phase == "dispatch"
+        assert mb.deadline_miss_count == 1
+        assert eng.dispatch_count == 0  # it never occupied a batch slot
+
+
+def test_close_fails_queued_futures_instead_of_hanging():
+    eng, mb, f0 = _blocked_batcher()
+    rows = _rows(2, seed=5)
+    f1, f2 = mb.submit(rows[0]), mb.submit(rows[1])
+    closer = threading.Thread(target=mb.close)
+    closer.start()
+    eng.gate.set()  # the in-flight dispatch completes; close() reaps it
+    closer.join(timeout=30)
+    assert not closer.is_alive()
+    assert isinstance(f0.result(timeout=30), Decision)  # in-flight served
+    for f in (f1, f2):  # queued-at-close: typed failure, never a hang
+        with pytest.raises(BatcherClosedError):
+            f.result(timeout=30)
+    with pytest.raises(BatcherClosedError):
+        mb.submit(rows[0])
+
+
+def test_drain_flushes_then_blocks_admissions():
+    eng = FakeEngine()
+    mb = MicroBatcher(eng, max_batch_wait_ms=1.0)
+    futs = [mb.submit(r) for r in _rows(5, seed=6)]
+    assert mb.drain(timeout=30) is True
+    for f in futs:
+        assert isinstance(f.result(timeout=1), Decision)
+    with pytest.raises(BatcherClosedError, match="draining"):
+        mb.submit(_rows(1)[0])
+    assert mb.health()["draining"] is True
+    mb.close()
+
+
+def test_breaker_trips_then_fails_fast_and_recovers():
+    eng = FakeEngine()
+    eng.fail_next = 2
+    breaker = CircuitBreaker(2, recovery_time=0.05)
+    with MicroBatcher(eng, max_batch_wait_ms=0.0, breaker=breaker) as mb:
+        rows = _rows(4, seed=7)
+        for i in range(2):  # two dispatch faults trip the breaker...
+            with pytest.raises(RuntimeError, match="injected"):
+                mb.submit(rows[i]).result(timeout=30)
+        assert breaker.state == "open"
+        with pytest.raises(CircuitOpenError):  # ...open = fail fast
+            mb.submit(rows[2]).result(timeout=30)
+        assert mb.health()["breaker_state"] == "open"
+        assert mb.dispatch_failures == 2
+        assert mb.breaker_open_count == 1
+        time.sleep(0.06)  # recovery window: the next dispatch is the probe
+        assert isinstance(mb.submit(rows[3]).result(timeout=30), Decision)
+        assert breaker.state == "closed"
+
+
+def test_worker_survives_dispatch_exception_and_keeps_serving():
+    eng = FakeEngine()
+    eng.fail_next = 1
+    with MicroBatcher(eng, max_batch_wait_ms=0.0) as mb:
+        rows = _rows(2, seed=8)
+        with pytest.raises(RuntimeError, match="injected"):
+            mb.submit(rows[0]).result(timeout=30)
+        # the SAME worker thread serves the next request
+        assert isinstance(mb.submit(rows[1]).result(timeout=30), Decision)
+        assert mb.dispatch_failures == 1
+
+
+def test_health_surface_keys_and_oldest_age():
+    eng, mb, f0 = _blocked_batcher(max_queue=4)
+    mb.submit(_rows(1, seed=9)[0])
+    h = mb.health()
+    for key in (
+        "queue_depth", "inflight_requests", "oldest_request_age_s",
+        "breaker_state", "shed_count", "deadline_miss_count",
+        "dispatch_failures", "breaker_open_failures", "dispatches",
+        "coalesced_total", "max_queue", "draining", "closed",
+    ):
+        assert key in h, key
+    assert h["queue_depth"] == 1
+    assert h["inflight_requests"] == 1
+    assert h["oldest_request_age_s"] >= 0.0
+    assert h["max_queue"] == 4
+    eng.gate.set()
+    mb.close()
+    assert mb.health()["closed"] is True
+
+
+def test_batcher_from_config_wires_admission_and_breaker():
+    from gymfx_tpu.config import DEFAULT_VALUES
+
+    eng = FakeEngine()
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update(
+        serve_max_queue=7,
+        serve_shed_policy="evict_oldest",
+        serve_deadline_ms=250.0,
+        serve_breaker_threshold=3,
+        serve_breaker_recovery_s=1.5,
+    )
+    mb = batcher_from_config(eng, cfg)
+    try:
+        assert mb.max_queue == 7
+        assert mb.shed_policy == "evict_oldest"
+        assert mb.default_deadline_ms == 250.0
+        assert mb.breaker is not None
+        assert mb.breaker.failure_threshold == 3
+        assert mb.breaker.recovery_time == 1.5
+    finally:
+        mb.close()
+    # defaults: admission control OFF — the pre-overload fast path
+    mb = batcher_from_config(eng, dict(DEFAULT_VALUES))
+    try:
+        assert mb.max_queue is None
+        assert mb.default_deadline_ms is None
+    finally:
+        mb.close()
+
+
+def test_policy_validators_reject_unknown_names():
+    assert resolve_shed_policy("reject") == "reject"
+    assert resolve_fallback_policy("flat") == "flat"
+    with pytest.raises(ValueError, match="shed_policy"):
+        resolve_shed_policy("drop_everything")
+    with pytest.raises(ValueError, match="fallback"):
+        resolve_fallback_policy("panic")
+
+
+# ----------------------------------------------------------------------
+# serving chaos harness: FlakyEngine + the serve/burst profile grammar
+
+
+def test_flaky_engine_plan_tokens_and_delegation():
+    eng = FakeEngine()
+    sleeps = []
+    flaky = FlakyEngine(
+        eng, plan=["slow:40", "exc", "ok"], sleep=sleeps.append
+    )
+    rows = _rows(3, seed=10)
+    d = flaky.decide_batch(rows)  # slow: sleeps then dispatches
+    assert isinstance(d, Decision)
+    assert sleeps == [pytest.approx(0.04)]
+    with pytest.raises(InjectedDispatchError):
+        flaky.decide_batch(rows)
+    assert isinstance(flaky.decide_batch(rows), Decision)  # ok token
+    assert isinstance(flaky.decide_batch(rows), Decision)  # plan exhausted
+    assert flaky.dispatch_calls == 4
+    assert flaky.faults_injected == 2  # slow + exc
+    # attribute delegation: drops into MicroBatcher(engine=...) unchanged
+    assert flaky.buckets == eng.buckets
+    assert flaky.recurrent is False
+
+
+def test_flaky_engine_from_profile_inert_is_identity():
+    eng = FakeEngine()
+    profile = parse_fault_profile("")
+    assert flaky_engine_from_profile(eng, profile) is eng
+    profile = parse_fault_profile("serve=slow:10+exc;burst=16x2;seed=3")
+    wrapped = flaky_engine_from_profile(eng, profile, sleep=lambda s: None)
+    assert isinstance(wrapped, FlakyEngine)
+    assert profile["burst"] == {"size": 16, "rounds": 2}
+    with pytest.raises(ValueError, match="burst"):
+        parse_fault_profile("burst=0x4")
+
+
+def test_seeded_burst_overload_profile_end_to_end():
+    """Tier-1 chaos smoke: the scripted burst-overload profile drives
+    the admission-controlled batcher; sheds and deadline misses occur
+    and EVERY request resolves with a Decision or a typed error."""
+    profile = parse_fault_profile(
+        "serve=" + "+".join(["slow:80"] * 8) + ";burst=24x2;seed=0"
+    )
+    eng = FakeEngine()
+    flaky = flaky_engine_from_profile(eng, profile)  # real sleeps: 80ms
+    burst = profile["burst"]
+    outcomes = {"served": 0, "shed": 0, "deadline_miss": 0, "other": 0}
+    lock = threading.Lock()
+    mb = MicroBatcher(
+        flaky,
+        max_batch_wait_ms=1.0,
+        max_batch=4,
+        max_queue=8,
+        shed_policy="reject",
+        default_deadline_ms=40.0,
+    )
+
+    def client(i):
+        try:
+            mb.submit(_rows(1, seed=i)[0]).result(timeout=30)
+            kind = "served"
+        except ShedError:
+            kind = "shed"
+        except DeadlineExceeded:
+            kind = "deadline_miss"
+        except Exception:
+            kind = "other"
+        with lock:
+            outcomes[kind] += 1
+
+    for r in range(burst["rounds"]):
+        wave = [
+            threading.Thread(target=client, args=(r * burst["size"] + i,))
+            for i in range(burst["size"])
+        ]
+        for t in wave:
+            t.start()
+        for t in wave:
+            t.join(timeout=60)
+            assert not t.is_alive(), "a client hung: a future never resolved"
+    mb.close()
+    total = burst["size"] * burst["rounds"]
+    assert sum(outcomes.values()) == total  # no request went unaccounted
+    assert outcomes["other"] == 0, outcomes
+    assert outcomes["served"] > 0, outcomes
+    assert outcomes["shed"] + outcomes["deadline_miss"] > 0, outcomes
+
+
+# ----------------------------------------------------------------------
+# live-path degraded-mode fallbacks (PolicyDecisionService)
+
+
+def _service(**config_over):
+    from test_live_serve import _stack
+
+    return _stack(**config_over)
+
+
+def test_fallback_hold_on_dispatch_error_is_tagged(monkeypatch):
+    svc, t, closes = _service(serve_fallback="hold")
+    d, order = svc.decide_and_route(float(closes[0]))
+    assert svc.decision_records[-1].source == "model"
+
+    def boom(row, carry=None):
+        raise RuntimeError("engine fell over")
+
+    monkeypatch.setattr(svc.engine, "decide", boom)
+    n_calls = len(t.calls)
+    d, order = svc.decide_and_route(float(closes[1]))
+    assert int(d.action) == 0  # hold: keep the target...
+    assert order is None
+    assert len(t.calls) == n_calls  # ...and send NO venue traffic
+    assert np.isnan(float(d.value))  # synthetic decision is loud
+    rec = svc.decision_records[-1]
+    assert rec.source == "fallback"
+    assert rec.reason == "dispatch_error"
+    assert svc.fallback_count == 1
+    assert svc.decisions == 2
+
+
+def test_fallback_flat_routes_to_flat(monkeypatch):
+    svc, t, closes = _service(serve_fallback="flat")
+
+    def boom(row, carry=None):
+        raise RuntimeError("engine fell over")
+
+    monkeypatch.setattr(svc.engine, "decide", boom)
+    d, _order = svc.decide_and_route(float(closes[0]))
+    assert int(d.action) == 3
+    assert svc.decision_records[-1].reason == "dispatch_error"
+
+
+def test_fallback_reject_reraises(monkeypatch):
+    svc, _t, closes = _service(serve_fallback="reject")
+
+    def boom(row, carry=None):
+        raise RuntimeError("engine fell over")
+
+    monkeypatch.setattr(svc.engine, "decide", boom)
+    with pytest.raises(RuntimeError, match="fell over"):
+        svc.decide_and_route(float(closes[0]))
+
+
+def test_breaker_open_maps_to_breaker_open_fallback(monkeypatch):
+    # threshold 1: the first dispatch fault trips the serving breaker,
+    # and the NEXT tick hits the open breaker (no engine call at all)
+    svc, _t, closes = _service(
+        serve_fallback="hold",
+        serve_breaker_threshold=1,
+        serve_breaker_recovery_s=60.0,
+    )
+    assert svc.breaker is not None
+    calls = {"n": 0}
+
+    def boom(row, carry=None):
+        calls["n"] += 1
+        raise RuntimeError("engine fell over")
+
+    monkeypatch.setattr(svc.engine, "decide", boom)
+    svc.decide_and_route(float(closes[0]))
+    assert svc.decision_records[-1].reason == "dispatch_error"
+    svc.decide_and_route(float(closes[1]))
+    assert svc.decision_records[-1].reason == "breaker_open"
+    assert calls["n"] == 1  # the open breaker never touched the engine
+
+
+def test_stale_feed_watchdog_triggers_fallback():
+    clock = {"t": 100.0}
+    svc, _t, closes = _service(
+        serve_fallback="hold", feed_stale_after_s=5.0
+    )
+    svc._clock = lambda: clock["t"]
+    svc._last_bar_at = None  # restart the watchdog under the fake clock
+    d, _ = svc.decide_and_route(float(closes[0]))
+    assert svc.decision_records[-1].source == "model"
+    clock["t"] += 2.0  # fresh bar: under the threshold
+    d, _ = svc.decide_and_route(float(closes[1]))
+    assert svc.decision_records[-1].source == "model"
+    clock["t"] += 60.0  # the feed gapped: the window behind this bar lies
+    d, _ = svc.decide_and_route(float(closes[2]))
+    rec = svc.decision_records[-1]
+    assert rec.source == "fallback"
+    assert rec.reason == "stale_feed"
+    assert int(d.action) == 0
+    assert svc.feed_stale_count == 1
+    clock["t"] += 1.0  # cadence restored: back to the model
+    d, _ = svc.decide_and_route(float(closes[3]))
+    assert svc.decision_records[-1].source == "model"
+
+
+def test_batcher_path_shed_maps_to_shed_fallback(monkeypatch):
+    svc, _t, closes = _service(serve_fallback="hold")
+
+    class AlwaysShedBatcher:
+        def submit(self, row, carry=None, *, deadline_ms=None):
+            raise ShedError("queue full", reason="queue_full")
+
+    svc.batcher = AlwaysShedBatcher()
+    d, order = svc.decide_and_route(float(closes[0]))
+    assert int(d.action) == 0 and order is None
+    rec = svc.decision_records[-1]
+    assert rec.source == "fallback" and rec.reason == "shed"
