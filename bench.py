@@ -133,6 +133,20 @@ def main() -> None:
         steps_per_sec = steps_per_iter / per_step_single
         util = mfu(step_flops, args.iters, dt1, jax.devices()[0])
         overhead = None
+
+    # analytic cross-check of the XLA cost-model MFU: closed-form FLOPs
+    # from the policy's parameter shapes (telemetry/mfu.py), plus device
+    # memory accounting — keys are always present, null off-TPU
+    from gymfx_tpu.telemetry.mfu import analytic_train_step_flops, mfu_report
+
+    analytic = analytic_train_step_flops(
+        state.params,
+        num_envs=args.n_envs,
+        horizon=args.horizon,
+        update_epochs=int(config["ppo_epochs"]),
+    )
+    per_step_s = per_step if K > 1 else per_step_single
+    report = mfu_report(analytic, per_step_s, jax.devices()[0])
     print(
         json.dumps(
             {
@@ -161,6 +175,10 @@ def main() -> None:
                 "update_ms": (
                     round(update_ms, 3) if update_ms is not None else None
                 ),
+                # analytic FLOP model + memory accounting
+                # (gymfx_tpu/telemetry/mfu.py); null where the backend
+                # cannot say (CPU peak FLOPs / memory_stats)
+                **report,
             }
         )
     )

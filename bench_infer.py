@@ -183,6 +183,18 @@ def main() -> None:
     profile = parse_fault_profile(profile_spec)
     burst = profile.get("burst") or {"size": 32, "rounds": 4}
     flaky = flaky_engine_from_profile(engine, profile)
+    # the chaos batcher runs INSTRUMENTED: every shed/deadline/latency
+    # event lands in a metrics registry exposed over a live (ephemeral-
+    # port) /metrics endpoint, and the line reports what one Prometheus
+    # scrape of the burst saw — proving the serving telemetry end to end
+    from gymfx_tpu.telemetry import MetricsRegistry, SLOWindow
+    from gymfx_tpu.telemetry.http import TelemetryServer, scrape
+    from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+    registry = MetricsRegistry()
+    instr = ServeInstruments(
+        registry, slo=SLOWindow(window_s=60.0), name="overload"
+    )
     over = MicroBatcher(
         flaky,
         max_batch_wait_ms=1.0,
@@ -190,7 +202,9 @@ def main() -> None:
         max_queue=16,
         shed_policy="reject",
         default_deadline_ms=50.0,
+        instruments=instr,
     )
+    metrics_server = TelemetryServer(registry, health_fn=over.health, port=0)
     outcomes = {"served": 0, "shed": 0, "deadline_miss": 0, "failed": 0}
     outcome_lock = threading.Lock()
 
@@ -224,6 +238,17 @@ def main() -> None:
     over_wall = time.perf_counter() - t0
     over_records = over.records
     over_health = over.health()
+    # one real HTTP scrape while the registry is hot: the exposition the
+    # bench reports is what an operator's Prometheus would have pulled
+    exposition = scrape(metrics_server.url + "/metrics")
+    scraped_served = scraped_shed = None
+    for line in exposition.splitlines():
+        if line.startswith("gymfx_serve_requests_total") and 'outcome="served"' in line:
+            scraped_served = float(line.rsplit(" ", 1)[1])
+        if line.startswith("gymfx_serve_requests_total") and 'outcome="shed"' in line:
+            scraped_shed = float(line.rsplit(" ", 1)[1])
+    slo_rates = instr.slo.rates()
+    metrics_server.close()
     over.close()
     submitted = int(burst["size"]) * int(burst["rounds"])
     over_lat_ms = np.asarray(
@@ -274,6 +299,19 @@ def main() -> None:
                         "deadline_miss_count"
                     ],
                     "dispatch_failures": over_health["dispatch_failures"],
+                },
+                # live-scrape proof: what one /metrics pull over the
+                # ephemeral telemetry endpoint reported for the burst,
+                # plus the rolling-window SLO gauges' view
+                "telemetry": {
+                    "scrape_bytes": len(exposition),
+                    "scraped_served_total": scraped_served,
+                    "scraped_shed_total": scraped_shed,
+                    "slo_shed_rate": round(slo_rates["shed_rate"], 4),
+                    "slo_deadline_miss_rate": round(
+                        slo_rates["deadline_miss_rate"], 4
+                    ),
+                    "slo_p99_ms": round(slo_rates["p99_s"] * 1e3, 3),
                 },
             }
         )

@@ -89,4 +89,15 @@ def parse_args(argv=None):
     parser.add_argument("--serve_breaker_recovery_s", type=float)
     parser.add_argument("--feed_stale_after_s", type=float)
 
+    # telemetry (docs/observability.md); all off unless set
+    parser.add_argument(
+        "--telemetry_enabled", action="store_true", default=None
+    )
+    parser.add_argument("--telemetry_jsonl", type=str)
+    parser.add_argument(
+        "--telemetry_spans", action="store_true", default=None
+    )
+    parser.add_argument("--telemetry_http_port", type=int)
+    parser.add_argument("--telemetry_slo_window_s", type=float)
+
     return parser.parse_known_args(argv)

@@ -171,4 +171,24 @@ DEFAULT_VALUES = {
     # exceeds this many seconds, PolicyDecisionService decides via the
     # fallback policy instead of acting on a stale window.  null = off
     "feed_stale_after_s": None,
+
+    # ---- telemetry (gymfx_tpu/telemetry/, docs/observability.md) ----
+    # ALL off by default: with every telemetry_* knob unset,
+    # telemetry_from_config returns None and the train/serve hot paths
+    # are bitwise identical to the pre-telemetry code.
+    # master switch: metrics registry + device metric drain + serve
+    # instruments
+    "telemetry_enabled": False,
+    # rotating JSONL sink path for structured rows (metric snapshots,
+    # spans, run summaries); null = no sink
+    "telemetry_jsonl": None,
+    # host-side span records around supersteps/serve dispatch (plus
+    # jax.profiler TraceAnnotation regions under an active trace)
+    "telemetry_spans": False,
+    # /metrics (Prometheus) + /healthz (JSON) endpoint port for the
+    # serving stack; 0 = ephemeral, null = no endpoint
+    "telemetry_http_port": None,
+    # rolling window for the serving SLO gauges (shed_rate,
+    # deadline_miss_rate, p99 over the last N seconds)
+    "telemetry_slo_window_s": 60.0,
 }

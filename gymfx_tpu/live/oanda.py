@@ -622,6 +622,7 @@ class PolicyDecisionService:
         batcher: Any = None,
         breaker: Any = None,
         clock: Callable[[], float] = time.monotonic,
+        telemetry: Any = None,
     ):
         from gymfx_tpu.serve.config import serve_config_from
         from gymfx_tpu.serve.engine import engine_from_config
@@ -671,6 +672,35 @@ class PolicyDecisionService:
         self.feed_stale_count = 0
         self.last_fallback_reason: Optional[str] = None
         self.decision_records = deque(maxlen=100_000)
+        # telemetry (gymfx_tpu.telemetry.Telemetry, None = off): decision
+        # counters by source/reason, a span per engine dispatch, the
+        # service breaker bound as registry callback gauges, and —
+        # when telemetry_http_port is configured — the /metrics +
+        # /healthz endpoint over this service's health()
+        self.telemetry = telemetry
+        from gymfx_tpu.telemetry import null_tracer
+
+        self._tracer = (
+            telemetry.tracer if telemetry is not None else null_tracer()
+        )
+        self._decisions_ctr = self._fallback_ctr = None
+        if telemetry is not None:
+            reg = telemetry.registry
+            self._decisions_ctr = reg.counter(
+                "gymfx_live_decisions_total",
+                "Serve decisions by source (model vs synthetic fallback)",
+                labels=("source",),
+            )
+            self._fallback_ctr = reg.counter(
+                "gymfx_live_fallback_total",
+                "Degraded-mode decisions by fallback reason",
+                labels=("reason",),
+            )
+            if self.breaker is not None:
+                from gymfx_tpu.telemetry import register_resilience
+
+                register_resilience(reg, breaker=self.breaker, name="live")
+            telemetry.start_http(health_fn=self.health)
 
     # ------------------------------------------------------------------
     def feed_age_s(self, now: Optional[float] = None) -> Optional[float]:
@@ -679,6 +709,29 @@ class PolicyDecisionService:
         if self._last_bar_at is None:
             return None
         return (self._clock() if now is None else now) - self._last_bar_at
+
+    def health(self) -> Dict[str, Any]:
+        """One consistent health view across the service, its batcher
+        and the registry-bound resilience objects — the /healthz payload
+        when telemetry runs the HTTP endpoint."""
+        out: Dict[str, Any] = {
+            "status": "ok",
+            "decisions": self.decisions,
+            "fallback_count": self.fallback_count,
+            "feed_stale_count": self.feed_stale_count,
+            "last_fallback_reason": self.last_fallback_reason,
+            "feed_age_s": self.feed_age_s(),
+            "breaker_state": (
+                None if self.breaker is None else self.breaker.state
+            ),
+        }
+        if self.batcher is not None and hasattr(self.batcher, "health"):
+            out["batcher"] = self.batcher.health()
+        if self.telemetry is not None:
+            from gymfx_tpu.telemetry import resilience_snapshot
+
+            out["resilience"] = resilience_snapshot(self.telemetry.registry)
+        return out
 
     def _model_decide(self, row):
         """One engine dispatch through the configured path; raises the
@@ -772,7 +825,11 @@ class PolicyDecisionService:
             from gymfx_tpu.serve.overload import OVERLOAD_ERRORS
 
             try:
-                decision = self._model_decide(row)
+                with self._tracer.span(
+                    "serve/dispatch",
+                    path="batcher" if self.batcher is not None else "direct",
+                ):
+                    decision = self._model_decide(row)
                 if self.engine.recurrent:
                     self._carry = decision.carry
             except OVERLOAD_ERRORS as exc:
@@ -791,6 +848,10 @@ class PolicyDecisionService:
                 reason=reason,
             )
         )
+        if self._decisions_ctr is not None:
+            self._decisions_ctr.inc(source=source)
+            if reason is not None:
+                self._fallback_ctr.inc(reason=reason)
         return decision
 
     def decide_and_route(

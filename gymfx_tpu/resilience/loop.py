@@ -51,6 +51,7 @@ class ResilientLoop:
         checkpoint_metadata: Optional[Dict[str, Any]] = None,
         max_consecutive_skips: int = 10,
         preempt_at: Optional[int] = None,
+        loggers: Tuple[Any, ...] = (),
     ):
         self.steps_per_iter = int(steps_per_iter)
         self.checkpoint_dir = str(checkpoint_dir) if checkpoint_dir else None
@@ -63,10 +64,24 @@ class ResilientLoop:
             if int(max_consecutive_skips or 0) > 0
             else None
         )
+        # delayed metric drains (DelayedLogger / DeviceMetricStream)
+        # tied to this loop's lifetime: they hold their newest snapshot
+        # one dispatch behind, so every abort path below must flush them
+        # or the final superstep's metrics are silently dropped
+        self.loggers = tuple(loggers)
         self.last_checkpoint_step: Optional[int] = None
         # (it_start, k, guard metrics) — scalars for k == 1, stacked
         # (k,) arrays for a fused superstep
         self._pending: Optional[Tuple[int, int, Dict[str, Any]]] = None
+
+    def _flush_loggers(self) -> None:
+        for logger in self.loggers:
+            try:
+                logger.finish()
+            except Exception:
+                # a telemetry drain failure must not mask the abort
+                # (or break a clean finish)
+                pass
 
     # ------------------------------------------------------------------
     def _save(self, state_fn: StateFn, step: int) -> None:
@@ -107,6 +122,7 @@ class ResilientLoop:
                     state_fn,
                     self.step_offset + (it_start + k) * self.steps_per_iter,
                 )
+            self._flush_loggers()
             raise
 
     # ------------------------------------------------------------------
@@ -139,6 +155,7 @@ class ResilientLoop:
         ):
             self._save(state_fn, self.step_offset + it_end * self.steps_per_iter)
         if self.preempt_at is not None and it_end >= self.preempt_at:
+            self._flush_loggers()
             raise SimulatedPreemptionError(it_end)
 
     def after_step(self, it: int, metrics: Dict[str, Any],
@@ -146,5 +163,8 @@ class ResilientLoop:
         self.after_superstep(it, 1, metrics, state_fn)
 
     def finish(self, state_fn: StateFn) -> None:
-        """Flush the one-step-delayed watchdog after the loop ends."""
+        """Flush the one-step-delayed watchdog — and any attached
+        delayed loggers — after the loop ends (the watchdog may still
+        raise, so loggers flush first)."""
+        self._flush_loggers()
         self._check_pending(state_fn)

@@ -119,6 +119,7 @@ class MicroBatcher:
         shed_policy: str = "reject",
         default_deadline_ms: Optional[float] = None,
         breaker: Optional[Any] = None,
+        instruments: Optional[Any] = None,
     ):
         if max_batch_wait_ms < 0:
             raise ValueError(
@@ -156,6 +157,12 @@ class MicroBatcher:
         self._closed = False
         self._draining = False
         self._stop = False
+        # optional telemetry (telemetry/instruments.ServeInstruments):
+        # None keeps this batcher exactly as before — the plain-int
+        # counters above are the only accounting on the off path
+        self._instr = instruments
+        if instruments is not None:
+            instruments.bind_batcher(self)
         self._worker = threading.Thread(
             target=self._run, name="gymfx-serve-batcher", daemon=True
         )
@@ -209,6 +216,8 @@ class MicroBatcher:
                 if self.shed_policy == "evict_oldest":
                     evicted = self._pending.popleft()
                 else:
+                    if self._instr is not None:
+                        self._instr.on_shed("queue_full")
                     raise ShedError(
                         f"request queue full ({self.max_queue}); request "
                         "rejected (shed_policy=reject)",
@@ -217,6 +226,8 @@ class MicroBatcher:
             self._pending.append(pending)
             self._cv.notify_all()
         if evicted is not None:
+            if self._instr is not None:
+                self._instr.on_shed("evicted")
             _resolve_exc(
                 evicted.future,
                 ShedError(
@@ -238,7 +249,7 @@ class MicroBatcher:
         bench_infer.py snapshots it after the chaos scenario)."""
         now = time.perf_counter()
         with self._cv:
-            return {
+            out = {
                 "queue_depth": len(self._pending),
                 "inflight_requests": self._inflight,
                 "oldest_request_age_s": (
@@ -257,6 +268,12 @@ class MicroBatcher:
                 "draining": self._draining,
                 "closed": self._closed,
             }
+        # with telemetry attached, fold the rolling SLO window in — the
+        # same numbers /metrics exposes, so health() and a scrape can
+        # never disagree about recent behavior
+        if self._instr is not None and self._instr.slo is not None:
+            out["slo"] = self._instr.slo.rates()
+        return out
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown, phase 1: stop admissions (submit raises
@@ -340,6 +357,8 @@ class MicroBatcher:
                     expired = p
                 else:
                     return p
+            if self._instr is not None:
+                self._instr.on_deadline_miss("pickup")
             _resolve_exc(
                 expired.future,
                 DeadlineExceeded(
@@ -388,6 +407,8 @@ class MicroBatcher:
                 if n_expired:
                     with self._cv:
                         self.deadline_miss_count += n_expired
+                    if self._instr is not None:
+                        self._instr.on_deadline_miss("dispatch", n_expired)
                 if live:
                     self._dispatch(live, t_pickup)
             finally:
@@ -407,6 +428,8 @@ class MicroBatcher:
                 # queue must not build behind a dead dependency
                 with self._cv:
                     self.breaker_open_count += n
+                if self._instr is not None:
+                    self._instr.on_breaker_open(n)
                 for p in batch:
                     _resolve_exc(p.future, exc)
                 return
@@ -427,6 +450,8 @@ class MicroBatcher:
                 self.breaker.record_failure()
             with self._cv:
                 self.dispatch_failures += 1
+            if self._instr is not None:
+                self._instr.on_dispatch_failure(n)
             for p in batch:
                 _resolve_exc(p.future, exc)
             return
@@ -446,16 +471,17 @@ class MicroBatcher:
                     else out.carry,
                 ),
             )
+        rows = [
+            RequestRecord(p.t_enqueue, t_pickup, t_dispatch, t_done, n, bucket)
+            for p in batch
+        ]
         with self._cv:
             self.dispatches += 1
             self.coalesced_total += n
             if len(self._records) + n <= self._records_cap:
-                self._records.extend(
-                    RequestRecord(
-                        p.t_enqueue, t_pickup, t_dispatch, t_done, n, bucket
-                    )
-                    for p in batch
-                )
+                self._records.extend(rows)
+        if self._instr is not None:
+            self._instr.on_batch_complete(rows)
 
 
 def _resolve_exc(future: Future, exc: BaseException) -> None:
@@ -472,12 +498,16 @@ def _resolve_result(future: Future, result: Any) -> None:
         pass
 
 
-def batcher_from_config(engine, config) -> MicroBatcher:
+def batcher_from_config(engine, config, *, instruments=None) -> MicroBatcher:
     """Build an admission-controlled batcher from the merged config dict
     (or an already-parsed :class:`~gymfx_tpu.serve.config.ServeConfig`),
     including the serving circuit breaker when
     ``serve_breaker_threshold`` > 0 — the one construction path shared
-    by the live wiring and bench_infer.py's chaos scenario."""
+    by the live wiring and bench_infer.py's chaos scenario.
+
+    ``instruments`` (telemetry/instruments.ServeInstruments, or None)
+    attaches the registry-backed serving metrics; None leaves the
+    batcher on its plain-counter path."""
     from gymfx_tpu.serve.config import ServeConfig, serve_config_from
 
     scfg = config if isinstance(config, ServeConfig) else serve_config_from(config)
@@ -495,4 +525,5 @@ def batcher_from_config(engine, config) -> MicroBatcher:
         shed_policy=scfg.shed_policy,
         default_deadline_ms=scfg.deadline_ms,
         breaker=breaker,
+        instruments=instruments,
     )

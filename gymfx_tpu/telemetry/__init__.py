@@ -1,0 +1,144 @@
+"""Unified telemetry: one registry, one sink, one tracer per run.
+
+The :class:`Telemetry` bundle is the object the trainers, the serving
+stack and the live wiring thread around; :func:`telemetry_from_config`
+is the single construction path off the merged config dict and returns
+``None`` when every ``telemetry_*`` knob is unset — callers take the
+exact pre-telemetry code path in that case, which is what keeps the
+off path bitwise identical (tests/test_telemetry.py pins this).
+
+Config keys (config/defaults.py, all default off):
+
+  ``telemetry_enabled``       master switch (registry + instruments)
+  ``telemetry_jsonl``         rotating JSONL sink path
+  ``telemetry_spans``         host span records (+ jax.profiler
+                              TraceAnnotation regions when profiling)
+  ``telemetry_http_port``     /metrics + /healthz endpoint; 0 binds an
+                              ephemeral port (serving only)
+  ``telemetry_slo_window_s``  rolling SLO window length (serving)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from gymfx_tpu.telemetry.device_stream import (  # noqa: F401
+    DelayedLogger,
+    DeviceMetricStream,
+)
+from gymfx_tpu.telemetry.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    global_registry,
+    register_resilience,
+    resilience_snapshot,
+)
+from gymfx_tpu.telemetry.sink import JsonlSink, append_jsonl  # noqa: F401
+from gymfx_tpu.telemetry.slo import SLOWindow  # noqa: F401
+from gymfx_tpu.telemetry.spans import Tracer, null_tracer  # noqa: F401
+
+__all__ = [
+    "Counter",
+    "DelayedLogger",
+    "DeviceMetricStream",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricsRegistry",
+    "SLOWindow",
+    "Telemetry",
+    "Tracer",
+    "append_jsonl",
+    "global_registry",
+    "null_tracer",
+    "register_resilience",
+    "resilience_snapshot",
+    "telemetry_from_config",
+]
+
+
+class Telemetry:
+    """Registry + sink + tracer + serving knobs for one run."""
+
+    def __init__(
+        self,
+        *,
+        registry: Optional[MetricsRegistry] = None,
+        sink: Optional[JsonlSink] = None,
+        tracer: Optional[Tracer] = None,
+        slo_window_s: float = 60.0,
+        http_port: Optional[int] = None,
+    ):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.sink = sink
+        self.tracer = tracer if tracer is not None else null_tracer()
+        self.slo_window_s = float(slo_window_s)
+        self.http_port = None if http_port is None else int(http_port)
+        self._server = None
+
+    # -- construction helpers the layers share -------------------------
+    def span(self, name: str, **attrs: Any):
+        return self.tracer.span(name, **attrs)
+
+    def device_stream(self, tag: str, *, iters: int, log_every: int = 0,
+                      steps_per_iter: Optional[int] = None) -> DeviceMetricStream:
+        return DeviceMetricStream(
+            tag, iters=iters, log_every=log_every, registry=self.registry,
+            sink=self.sink, steps_per_iter=steps_per_iter,
+        )
+
+    def serve_instruments(self, name: str = "serve"):
+        from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+        return ServeInstruments(
+            self.registry, slo=SLOWindow(self.slo_window_s), name=name
+        )
+
+    def start_http(self, health_fn=None):
+        """Start the /metrics + /healthz endpoint when
+        ``telemetry_http_port`` was configured (idempotent); returns the
+        server or None."""
+        if self.http_port is None:
+            return None
+        if self._server is None:
+            from gymfx_tpu.telemetry.http import TelemetryServer
+
+            self._server = TelemetryServer(
+                self.registry, health_fn=health_fn, port=self.http_port
+            )
+        return self._server
+
+    @property
+    def server(self):
+        return self._server
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        if self.sink is not None:
+            self.sink.close()
+
+
+def telemetry_from_config(config: Dict[str, Any]) -> Optional[Telemetry]:
+    """``None`` unless some ``telemetry_*`` knob is set — the contract
+    callers rely on to keep the off path untouched."""
+    enabled = bool(config.get("telemetry_enabled"))
+    jsonl = config.get("telemetry_jsonl") or None
+    spans = bool(config.get("telemetry_spans"))
+    port = config.get("telemetry_http_port")
+    port = None if port in (None, "") or int(port) < 0 else int(port)
+    if not (enabled or jsonl or spans or port is not None):
+        return None
+    registry = MetricsRegistry()
+    sink = JsonlSink(str(jsonl)) if jsonl else None
+    tracer = Tracer(enabled=spans, registry=registry if spans else None,
+                    sink=sink if spans else None)
+    return Telemetry(
+        registry=registry,
+        sink=sink,
+        tracer=tracer,
+        slo_window_s=float(config.get("telemetry_slo_window_s", 60.0) or 60.0),
+        http_port=port,
+    )

@@ -1,0 +1,152 @@
+"""On-device metric streams, drained host-side one dispatch late.
+
+The superstep driver (train/common.make_train_many) already stacks
+every per-iteration metric — guard counters included — on a leading
+``(k,)`` axis ON DEVICE inside the donated ``lax.scan``; nothing here
+adds device work.  :class:`DeviceMetricStream` is the host half: it
+holds each dispatch's stacked metrics tree as device arrays and only
+materializes them AFTER the next dispatch has been issued (the same
+pipelining trick as ResilientLoop's delayed guard fetch), so telemetry
+never inserts a hot host sync.  One drain per dispatch feeds
+
+  * the legacy ``log_every`` console line (the old DelayedLogger
+    behavior, preserved bit-for-bit — :class:`DelayedLogger` below is
+    the back-compat constructor);
+  * a :class:`~gymfx_tpu.telemetry.registry.MetricsRegistry`: guard
+    counters summed over the superstep into ``gymfx_train_*_total``
+    counters, every other scalar (loss, entropy, grad stats) as a
+    newest-value ``gymfx_train_metric`` gauge, plus iteration/env-step
+    progress counters;
+  * an optional JSONL sink row per drained dispatch.
+
+With no registry/sink and ``log_every=0`` the stream holds nothing and
+the training loop is exactly the pre-telemetry one.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# per-iteration guard counters: summed over the superstep axis when
+# drained (everything else is reported as a newest-value gauge)
+COUNTER_KEYS = ("nonfinite_skips", "guard_updates", "poisoned_env_resets")
+
+
+class DeviceMetricStream:
+    def __init__(
+        self,
+        tag: str,
+        *,
+        iters: int,
+        log_every: int = 0,
+        registry: Any = None,
+        sink: Any = None,
+        steps_per_iter: Optional[int] = None,
+        printer: Callable[[str], None] = print,
+    ):
+        self.tag = str(tag)
+        self.every = int(log_every or 0)
+        self.iters = int(iters)
+        self.registry = registry
+        self.sink = sink
+        self.steps_per_iter = (
+            None if steps_per_iter is None else int(steps_per_iter)
+        )
+        self._printer = printer
+        # (it_end, k, stacked device tree, want_print)
+        self._held: Optional[Tuple[int, int, Dict[str, Any], bool]] = None
+        self._counters = self._gauge = self._iters_ctr = self._steps_ctr = None
+        if registry is not None:
+            self._counters = {
+                key: registry.counter(
+                    f"gymfx_train_{key}_total",
+                    f"Cumulative train-step {key} (summed per superstep)",
+                    labels=("algo",),
+                )
+                for key in COUNTER_KEYS
+            }
+            self._gauge = registry.gauge(
+                "gymfx_train_metric",
+                "Newest per-iteration training scalar by metric name",
+                labels=("algo", "metric"),
+            )
+            self._iters_ctr = registry.counter(
+                "gymfx_train_iterations_total",
+                "Training iterations drained through telemetry",
+                labels=("algo",),
+            )
+            self._steps_ctr = registry.counter(
+                "gymfx_train_env_steps_total",
+                "Environment steps drained through telemetry",
+                labels=("algo",),
+            )
+
+    # ------------------------------------------------------------------
+    def after_dispatch(self, it_start: int, k: int,
+                       metrics: Dict[str, Any]) -> None:
+        """Call right after dispatching iterations
+        ``[it_start, it_start + k)``; ``metrics`` is the dispatch's
+        (device) metrics tree — per-iteration values stacked on a
+        leading ``(k,)`` axis, or plain scalars when ``k == 1``."""
+        self._flush()
+        want_print = bool(
+            self.every
+            and (it_start + k) // self.every > it_start // self.every
+        )
+        if want_print or self.registry is not None or self.sink is not None:
+            self._held = (it_start + k, k, metrics, want_print)
+
+    def finish(self) -> None:
+        """Flush the last held dispatch after (or when aborting) the
+        loop — ResilientLoop calls this on every exit path so the final
+        superstep's metrics are never silently dropped."""
+        self._flush()
+
+    # ------------------------------------------------------------------
+    def _flush(self) -> None:
+        if self._held is None:
+            return
+        import numpy as np
+
+        it_end, k, tree, want_print = self._held
+        self._held = None
+        host = {
+            key: np.ravel(np.asarray(value)) for key, value in tree.items()
+        }
+        newest = {
+            key: float(arr[-1]) for key, arr in host.items() if arr.size
+        }
+        if want_print:
+            self._printer(
+                f"[{self.tag}] iter {it_end}/{self.iters} {newest}"
+            )
+        if self.registry is not None:
+            for key, ctr in self._counters.items():
+                arr = host.get(key)
+                if arr is not None and arr.size:
+                    ctr.inc(float(arr.sum()), algo=self.tag)
+            for key, value in newest.items():
+                if key not in COUNTER_KEYS:
+                    self._gauge.set(value, algo=self.tag, metric=key)
+            self._iters_ctr.inc(float(k), algo=self.tag)
+            if self.steps_per_iter is not None:
+                self._steps_ctr.inc(
+                    float(k * self.steps_per_iter), algo=self.tag
+                )
+        if self.sink is not None:
+            self.sink.append({
+                "kind": "train_metrics",
+                "algo": self.tag,
+                "iter": it_end,
+                "iters": self.iters,
+                **newest,
+            })
+
+
+class DelayedLogger(DeviceMetricStream):
+    """One-dispatch-delayed ``log_every`` console logging — the original
+    train/common.py surface, now a thin construction of the stream with
+    telemetry off.  The snapshot for iteration ``i`` is held as device
+    arrays and stringified only after the NEXT dispatch is in flight."""
+
+    def __init__(self, tag: str, log_every: int, iters: int):
+        super().__init__(tag, iters=iters, log_every=log_every)

@@ -1,0 +1,182 @@
+"""Serving-path instruments: the registry-backed view of a
+MicroBatcher and the decision service.
+
+``ServeInstruments`` owns every serve-side metric family so the batcher
+stays free of metric-name string literals; the batcher calls the
+``on_*`` hooks from its existing counter sites (all no-cost when no
+instruments object is injected — the off path keeps the plain-int
+counters it always had).  Queue pressure is NOT mirrored per mutation:
+:meth:`bind_batcher` registers callback gauges that read the live
+batcher at scrape time.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# batch sizes are powers-of-two-ish bucket ladders; request stage
+# latencies reuse the default request-shaped edges
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+class ServeInstruments:
+    def __init__(self, registry: Any, *, slo: Any = None,
+                 name: str = "serve"):
+        self.registry = registry
+        self.slo = slo
+        self.name = str(name)
+        self.requests = registry.counter(
+            "gymfx_serve_requests_total",
+            "Requests resolved by terminal outcome",
+            labels=("batcher", "outcome"),
+        )
+        self.shed = registry.counter(
+            "gymfx_serve_shed_total",
+            "Requests shed by admission control, by shed reason",
+            labels=("batcher", "reason"),
+        )
+        self.deadline = registry.counter(
+            "gymfx_serve_deadline_miss_total",
+            "Requests failed past their deadline, by detection phase",
+            labels=("batcher", "phase"),
+        )
+        self.breaker_open = registry.counter(
+            "gymfx_serve_breaker_open_total",
+            "Requests failed fast by an open dispatch circuit breaker",
+            labels=("batcher",),
+        )
+        self.failures = registry.counter(
+            "gymfx_serve_dispatch_failures_total",
+            "Engine dispatches that raised (whole batch failed)",
+            labels=("batcher",),
+        )
+        self.dispatches = registry.counter(
+            "gymfx_serve_dispatches_total",
+            "Engine dispatches completed",
+            labels=("batcher",),
+        )
+        self.batch_size = registry.histogram(
+            "gymfx_serve_batch_size",
+            "Real requests coalesced per engine dispatch",
+            labels=("batcher",),
+            buckets=BATCH_SIZE_BUCKETS,
+        )
+        self.h_queue = registry.histogram(
+            "gymfx_serve_enqueue_to_pickup_seconds",
+            "submit() to worker pickup (queue wait)",
+            labels=("batcher",),
+        )
+        self.h_window = registry.histogram(
+            "gymfx_serve_pickup_to_dispatch_seconds",
+            "worker pickup to engine dispatch (batching window)",
+            labels=("batcher",),
+        )
+        self.h_dispatch = registry.histogram(
+            "gymfx_serve_dispatch_seconds",
+            "engine dispatch to response resolution",
+            labels=("batcher",),
+        )
+        self.h_latency = registry.histogram(
+            "gymfx_serve_latency_seconds",
+            "submit() to response resolution (end-to-end)",
+            labels=("batcher",),
+        )
+
+    # -- batcher hook points (called from MicroBatcher when injected) --
+    def on_shed(self, reason: str, n: int = 1) -> None:
+        self.shed.inc(n, batcher=self.name, reason=reason)
+        self.requests.inc(n, batcher=self.name, outcome="shed")
+        if self.slo is not None:
+            for _ in range(n):
+                self.slo.observe("shed")
+
+    def on_deadline_miss(self, phase: str, n: int = 1) -> None:
+        self.deadline.inc(n, batcher=self.name, phase=phase)
+        self.requests.inc(n, batcher=self.name, outcome="deadline_miss")
+        if self.slo is not None:
+            for _ in range(n):
+                self.slo.observe("deadline_miss")
+
+    def on_breaker_open(self, n: int = 1) -> None:
+        self.breaker_open.inc(n, batcher=self.name)
+        self.requests.inc(n, batcher=self.name, outcome="breaker_open")
+        if self.slo is not None:
+            for _ in range(n):
+                self.slo.observe("breaker_open")
+
+    def on_dispatch_failure(self, n: int = 1) -> None:
+        self.failures.inc(1, batcher=self.name)
+        self.requests.inc(n, batcher=self.name, outcome="failed")
+        if self.slo is not None:
+            for _ in range(n):
+                self.slo.observe("failed")
+
+    def on_batch_complete(self, records) -> None:
+        """``records`` — the dispatch's RequestRecord rows (one per
+        served request, shared pickup/dispatch/done stamps)."""
+        rows = list(records)
+        if not rows:
+            return
+        self.dispatches.inc(1, batcher=self.name)
+        self.batch_size.observe(float(len(rows)), batcher=self.name)
+        for r in rows:
+            self.requests.inc(1, batcher=self.name, outcome="served")
+            self.h_queue.observe(
+                max(0.0, r.t_pickup - r.t_enqueue), batcher=self.name
+            )
+            self.h_window.observe(
+                max(0.0, r.t_dispatch - r.t_pickup), batcher=self.name
+            )
+            self.h_dispatch.observe(
+                max(0.0, r.t_done - r.t_dispatch), batcher=self.name
+            )
+            self.h_latency.observe(r.latency_s, batcher=self.name)
+            if self.slo is not None:
+                self.slo.observe("served", latency_s=r.latency_s)
+
+    # ------------------------------------------------------------------
+    def bind_batcher(self, batcher: Any) -> None:
+        """Register scrape-time callback gauges over the live batcher
+        (queue depth, in-flight count, breaker state) and the rolling
+        SLO gauges when an SLO window is attached."""
+        depth = self.registry.gauge(
+            "gymfx_serve_queue_depth",
+            "Requests currently queued (read at scrape time)",
+            labels=("batcher",),
+        )
+        # len() on a deque is atomic under the GIL: safe without the
+        # batcher lock, and a scrape must never contend with dispatch
+        depth.set_function(
+            lambda b=batcher: float(len(b._pending)), batcher=self.name
+        )
+        inflight = self.registry.gauge(
+            "gymfx_serve_inflight",
+            "Batches currently inside an engine dispatch",
+            labels=("batcher",),
+        )
+        inflight.set_function(
+            lambda b=batcher: float(b._inflight), batcher=self.name
+        )
+        if batcher.max_queue is not None:
+            cap = self.registry.gauge(
+                "gymfx_serve_queue_capacity",
+                "Configured admission-control queue bound",
+                labels=("batcher",),
+            )
+            cap.set(float(batcher.max_queue), batcher=self.name)
+        if batcher.breaker is not None:
+            from gymfx_tpu.telemetry.registry import register_resilience
+
+            register_resilience(
+                self.registry, breaker=batcher.breaker, name=self.name
+            )
+        if self.slo is not None:
+            self.slo.register_gauges(self.registry)
+
+
+def instruments_from_telemetry(telemetry: Optional[Any],
+                               name: str = "serve") -> Optional[ServeInstruments]:
+    """The one construction path serving callers share: ``None`` in,
+    ``None`` out (telemetry off keeps the batcher untouched)."""
+    if telemetry is None:
+        return None
+    return telemetry.serve_instruments(name=name)

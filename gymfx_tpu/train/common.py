@@ -6,6 +6,14 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+# DelayedLogger grew into the telemetry device stream (same delayed-
+# drain discipline, optionally feeding a MetricsRegistry/JSONL sink);
+# the original class name and construction stay importable from here.
+from gymfx_tpu.telemetry.device_stream import (  # noqa: F401
+    DelayedLogger,
+    DeviceMetricStream,
+)
+
 
 def make_train_many(step_impl):
     """Superstep driver: jitted ``train_many(state, k)`` running ``k``
@@ -30,42 +38,6 @@ def make_train_many(step_impl):
         return jax.lax.scan(body, state, None, length=k)
 
     return jax.jit(impl, static_argnums=1, donate_argnums=0)
-
-
-class DelayedLogger:
-    """One-dispatch-delayed ``log_every`` metrics printing.
-
-    The snapshot for iteration ``i`` is floated (held as device arrays)
-    and only converted to host floats after the NEXT dispatch has been
-    issued — the same pipelining trick as ResilientLoop's delayed guard
-    fetch, so logging never stalls the device pipeline with a hot host
-    sync.  ``finish()`` flushes the last held snapshot after the loop.
-    """
-
-    def __init__(self, tag: str, log_every: int, iters: int):
-        self.tag = str(tag)
-        self.every = int(log_every or 0)
-        self.iters = int(iters)
-        self._held: Optional[Tuple[int, Dict[str, Any]]] = None
-
-    def _flush(self) -> None:
-        if self._held is None:
-            return
-        it_end, metrics = self._held
-        self._held = None
-        snap = {k: float(v) for k, v in metrics.items()}
-        print(f"[{self.tag}] iter {it_end}/{self.iters} {snap}")
-
-    def after_dispatch(self, it_start: int, k: int, metrics: Dict[str, Any]) -> None:
-        """Call right after dispatching iterations
-        ``[it_start, it_start + k)``; ``metrics`` is the newest
-        iteration's (device) metrics tree."""
-        self._flush()
-        if self.every and (it_start + k) // self.every > it_start // self.every:
-            self._held = (it_start + k, metrics)
-
-    def finish(self) -> None:
-        self._flush()
 
 
 def build_train_eval_envs(config: Dict[str, Any]) -> Tuple[Any, Optional[Any]]:

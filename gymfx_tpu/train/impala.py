@@ -430,8 +430,12 @@ class ImpalaTrainer:
         )
 
     def _train_step_impl(self, state: ImpalaState):
-        inter, rollout_out = self._rollout_phase(state)
-        return self._update_phase(inter, rollout_out)
+        # phase-named XLA ops for profiler attribution (trace-time
+        # metadata only; numerics unchanged) — same scheme as PPO
+        with jax.named_scope("rollout"):
+            inter, rollout_out = self._rollout_phase(state)
+        with jax.named_scope("update"):
+            return self._update_phase(inter, rollout_out)
 
     # ------------------------------------------------------------------
     def train_step(self, state: ImpalaState):
@@ -450,7 +454,8 @@ class ImpalaTrainer:
               checkpoint_metadata: Optional[Dict[str, Any]] = None,
               max_consecutive_skips: int = 10,
               preempt_at: Optional[int] = None,
-              supersteps_per_dispatch: int = 1):
+              supersteps_per_dispatch: int = 1,
+              telemetry=None):
         if initial_state is not None:
             state = initial_state
             if self.mesh is not None:
@@ -470,6 +475,16 @@ class ImpalaTrainer:
         iters = max(1, int(total_env_steps) // per_iter)
         from gymfx_tpu.resilience.loop import ResilientLoop
 
+        K = max(1, int(supersteps_per_dispatch or 1))
+        from gymfx_tpu.train.common import DelayedLogger
+
+        if telemetry is not None:
+            logger = telemetry.device_stream(
+                "impala", iters=iters, log_every=log_every,
+                steps_per_iter=per_iter,
+            )
+        else:
+            logger = DelayedLogger("impala", log_every, iters)
         hooks = ResilientLoop(
             steps_per_iter=per_iter,
             checkpoint_dir=checkpoint_dir,
@@ -480,28 +495,37 @@ class ImpalaTrainer:
                 max_consecutive_skips if self.icfg.nonfinite_guard else 0
             ),
             preempt_at=preempt_at,
+            loggers=(logger,),
         )
-        from gymfx_tpu.train.common import DelayedLogger
+        if telemetry is not None and hooks.monitor is not None:
+            from gymfx_tpu.telemetry import register_resilience
 
-        K = max(1, int(supersteps_per_dispatch or 1))
-        logger = DelayedLogger("impala", log_every, iters)
+            register_resilience(
+                telemetry.registry, monitor=hooks.monitor, name="impala"
+            )
+        from gymfx_tpu.telemetry import null_tracer
+
+        tracer = telemetry.tracer if telemetry is not None else null_tracer()
         t0 = time.perf_counter()
         metrics: Dict[str, Any] = {}
         it = 0
         while it < iters:
             k = min(K, iters - it)
-            if k == 1:
-                state, metrics = self.train_step(state)
-                guard_metrics = metrics
-            else:
-                state, stacked = self.train_many(state, k)
-                metrics = jax.tree.map(lambda x: x[-1], stacked)
-                guard_metrics = stacked
+            with tracer.span("train/superstep", algo="impala", it=it, k=k):
+                if k == 1:
+                    state, metrics = self.train_step(state)
+                    guard_metrics = metrics
+                else:
+                    state, stacked = self.train_many(state, k)
+                    metrics = jax.tree.map(lambda x: x[-1], stacked)
+                    guard_metrics = stacked
+            # logger first: an aborting hook flushes the attached logger,
+            # which must already hold this superstep's metrics (see PPO)
+            logger.after_dispatch(it, k, guard_metrics)
             hooks.after_superstep(
                 it, k, guard_metrics,
                 lambda: (state._asdict(), state.learner_params),
             )
-            logger.after_dispatch(it, k, metrics)
             it += k
         logger.finish()
         hooks.finish(lambda: (state._asdict(), state.learner_params))
@@ -542,6 +566,9 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     resume_state, resume_params, resume_step = resume_from_config(
         config, trainer, ImpalaState
     )
+    from gymfx_tpu.telemetry import telemetry_from_config
+
+    telemetry = telemetry_from_config(config)
     state, train_metrics = trainer.train(
         total, seed=int(config.get("seed", 0) or 0),
         initial_state=resume_state, initial_params=resume_params,
@@ -557,7 +584,13 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
             config.get("supersteps_per_dispatch", 1) or 1
         ),
         preempt_at=profile.get("preempt_at"),
+        telemetry=telemetry,
     )
+    if telemetry is not None and telemetry.sink is not None:
+        telemetry.sink.append({
+            "kind": "metrics_snapshot", "algo": "impala",
+            "registry": telemetry.registry.snapshot(),
+        })
 
     # greedy eval through the shared evaluate() machinery
     from gymfx_tpu.train import ppo as ppo_mod

@@ -547,8 +547,13 @@ class PPOTrainer:
         return new_state, metrics
 
     def _train_step_impl(self, state: TrainState):
-        inter, rollout_out = self._rollout_phase(state)
-        return self._update_phase(inter, rollout_out)
+        # named_scope labels the XLA ops by phase (trace-time metadata
+        # only — the compiled program and numerics are unchanged), so a
+        # profiler capture attributes device time to rollout vs update
+        with jax.named_scope("rollout"):
+            inter, rollout_out = self._rollout_phase(state)
+        with jax.named_scope("update"):
+            return self._update_phase(inter, rollout_out)
 
     # ------------------------------------------------------------------
     def train_step(self, state: TrainState):
@@ -568,7 +573,8 @@ class PPOTrainer:
               checkpoint_metadata: Optional[Dict[str, Any]] = None,
               max_consecutive_skips: int = 10,
               preempt_at: Optional[int] = None,
-              supersteps_per_dispatch: int = 1):
+              supersteps_per_dispatch: int = 1,
+              telemetry=None):
         """Run PPO for ~total_env_steps; log metrics every ``log_every``
         iterations when > 0.  ``initial_state`` continues a checkpointed
         run exactly (full TrainState: params + opt_state + env batch +
@@ -586,7 +592,13 @@ class PPOTrainer:
         under the non-finite guard, ``max_consecutive_skips`` fully-
         skipped steps in a row abort with NonFiniteDivergenceError;
         ``preempt_at`` injects a SimulatedPreemptionError after that
-        iteration (checkpoint/resume drills)."""
+        iteration (checkpoint/resume drills).
+
+        ``telemetry`` (a :class:`gymfx_tpu.telemetry.Telemetry` bundle,
+        None = off) drains the superstep's on-device metric stack into
+        its registry/sink once per dispatch and wraps each dispatch in a
+        span — no extra host syncs either way; with ``telemetry=None``
+        this loop is the exact pre-telemetry one."""
         if initial_state is not None:
             state = initial_state
             if self.mesh is not None:
@@ -603,6 +615,16 @@ class PPOTrainer:
         iters = max(1, int(total_env_steps) // steps_per_iter)
         from gymfx_tpu.resilience.loop import ResilientLoop
 
+        K = max(1, int(supersteps_per_dispatch or 1))
+        from gymfx_tpu.train.common import DelayedLogger
+
+        if telemetry is not None:
+            logger = telemetry.device_stream(
+                "ppo", iters=iters, log_every=log_every,
+                steps_per_iter=steps_per_iter,
+            )
+        else:
+            logger = DelayedLogger("ppo", log_every, iters)
         hooks = ResilientLoop(
             steps_per_iter=steps_per_iter,
             checkpoint_dir=checkpoint_dir,
@@ -613,28 +635,38 @@ class PPOTrainer:
                 max_consecutive_skips if self.pcfg.nonfinite_guard else 0
             ),
             preempt_at=preempt_at,
+            loggers=(logger,),
         )
-        from gymfx_tpu.train.common import DelayedLogger
+        if telemetry is not None and hooks.monitor is not None:
+            from gymfx_tpu.telemetry import register_resilience
 
-        K = max(1, int(supersteps_per_dispatch or 1))
-        logger = DelayedLogger("ppo", log_every, iters)
+            register_resilience(
+                telemetry.registry, monitor=hooks.monitor, name="ppo"
+            )
+        from gymfx_tpu.telemetry import null_tracer
+
+        tracer = telemetry.tracer if telemetry is not None else null_tracer()
         t0 = time.perf_counter()
         metrics = {}
         it = 0
         while it < iters:
             k = min(K, iters - it)
-            if k == 1:
-                state, metrics = self.train_step(state)
-                guard_metrics = metrics
-            else:
-                state, stacked = self.train_many(state, k)
-                # newest iteration's metrics, still on device (no sync)
-                metrics = jax.tree.map(lambda x: x[-1], stacked)
-                guard_metrics = stacked
+            with tracer.span("train/superstep", algo="ppo", it=it, k=k):
+                if k == 1:
+                    state, metrics = self.train_step(state)
+                    guard_metrics = metrics
+                else:
+                    state, stacked = self.train_many(state, k)
+                    # newest iteration's metrics, still on device (no sync)
+                    metrics = jax.tree.map(lambda x: x[-1], stacked)
+                    guard_metrics = stacked
+            # logger BEFORE hooks: when the hooks abort (preemption,
+            # divergence) they flush the attached logger, so the final
+            # superstep's held metrics must already be in its hands
+            logger.after_dispatch(it, k, guard_metrics)
             hooks.after_superstep(
                 it, k, guard_metrics, lambda: (state._asdict(), state.params)
             )
-            logger.after_dispatch(it, k, metrics)
             it += k
         logger.finish()
         hooks.finish(lambda: (state._asdict(), state.params))
@@ -774,6 +806,9 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     )
     ckpt_meta = {"policy": pcfg.policy,
                  "policy_kwargs": dict(pcfg.policy_kwargs)}
+    from gymfx_tpu.telemetry import telemetry_from_config
+
+    telemetry = telemetry_from_config(config)
     state, train_metrics = trainer.train(
         total, seed=int(config.get("seed", 0) or 0),
         initial_params=resume_params, initial_state=resume_state,
@@ -788,7 +823,13 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         supersteps_per_dispatch=int(
             config.get("supersteps_per_dispatch", 1) or 1
         ),
+        telemetry=telemetry,
     )
+    if telemetry is not None and telemetry.sink is not None:
+        telemetry.sink.append({
+            "kind": "metrics_snapshot", "algo": "ppo",
+            "registry": telemetry.registry.snapshot(),
+        })
 
     # out-of-sample: greedy episode on bars the agent never trained on
     # (BASELINE metric 2 made scientifically meaningful); the in-sample

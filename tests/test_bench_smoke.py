@@ -9,6 +9,9 @@ import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "tools"))
+
+from check_bench_contract import validate_record  # noqa: E402
 
 
 def test_bench_quick_prints_single_json_line_contract():
@@ -25,6 +28,10 @@ def test_bench_quick_prints_single_json_line_contract():
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     assert lines, f"bench printed nothing to stdout: {proc.stderr[-2000:]}"
     payload = json.loads(lines[-1])  # the contract: final line IS the JSON
+    # committed key-set contract (tools/bench_contract_schema.json) —
+    # includes the r7 telemetry keys mfu_analytic / device_memory_bytes
+    problems = validate_record(payload)
+    assert not problems, (problems, payload)
     for key in ("metric", "value", "vs_baseline"):
         assert key in payload, (key, payload)
     assert payload["metric"] == "ppo_env_steps_per_sec_per_chip"

@@ -1,0 +1,617 @@
+"""Unified telemetry (gymfx_tpu/telemetry/): registry semantics,
+Prometheus exposition, rotating JSONL sink, rolling SLO window, span
+tracing, on-device metric stream drains, resilience bindings and the
+serve /metrics endpoint end-to-end.
+
+The off-path contract is pinned here too: with every ``telemetry_*``
+knob unset, ``telemetry_from_config`` returns None and the holders
+(DelayedLogger, un-instrumented batcher) buffer nothing — the hot
+paths are exactly the pre-telemetry ones.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gymfx_tpu.telemetry import (
+    DelayedLogger,
+    DeviceMetricStream,
+    JsonlSink,
+    MetricsRegistry,
+    SLOWindow,
+    Tracer,
+    append_jsonl,
+    null_tracer,
+    register_resilience,
+    resilience_snapshot,
+    telemetry_from_config,
+)
+from gymfx_tpu.telemetry.prometheus import render
+from gymfx_tpu.telemetry.spans import SPAN_BUCKETS
+
+
+# ----------------------------------------------------------------------
+# registry: counters / gauges / histograms
+
+
+def test_counter_concurrent_increments_are_exact():
+    reg = MetricsRegistry()
+    ctr = reg.counter("t_hits_total", "hits", labels=("path",))
+    n_threads, n_incs = 8, 500
+
+    def worker():
+        for _ in range(n_incs):
+            ctr.inc(path="/a")
+            ctr.inc(2.0, path="/b")
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ctr.value(path="/a") == n_threads * n_incs
+    assert ctr.value(path="/b") == 2.0 * n_threads * n_incs
+
+
+def test_counter_rejects_negative_and_label_mismatch():
+    reg = MetricsRegistry()
+    ctr = reg.counter("t_total", labels=("k",))
+    with pytest.raises(ValueError, match="cannot decrease"):
+        ctr.inc(-1.0, k="x")
+    with pytest.raises(ValueError, match="label"):
+        ctr.inc(wrong="x")
+
+
+def test_registry_get_or_create_idempotent_and_mismatch_raises():
+    reg = MetricsRegistry()
+    a = reg.counter("t_same", labels=("x",))
+    assert reg.counter("t_same", labels=("x",)) is a
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("t_same", labels=("x",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("t_same", labels=("y",))
+
+
+def test_gauge_callback_read_at_scrape_and_dead_callback_skipped():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_depth", labels=("q",))
+    box = {"v": 3.0}
+    g.set_function(lambda: box["v"], q="live")
+    g.set_function(lambda: 1 / 0, q="dead")
+    g.set(7.0, q="plain")
+    assert g.value(q="live") == 3.0
+    box["v"] = 5.0
+    assert g.value(q="live") == 5.0  # callback, not a mirrored copy
+    # exposition must survive the dead callback and keep the others
+    sampled = dict(g.samples())
+    assert sampled[("live",)] == 5.0
+    assert sampled[("plain",)] == 7.0
+    assert ("dead",) not in sampled
+    with pytest.raises(ValueError, match="callback-backed"):
+        g.inc(q="live")
+
+
+def test_histogram_bucket_edges_le_semantics():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 7.0):  # edges land IN their bucket
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["buckets"] == {0.1: 2, 1.0: 4}  # cumulative; 7.0 only +Inf
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(8.65)
+    with pytest.raises(ValueError, match="strictly"):
+        reg.histogram("t_bad", buckets=(1.0, 1.0))
+
+
+def test_registry_snapshot_is_json_ready():
+    reg = MetricsRegistry()
+    reg.counter("t_c", "help c", labels=("k",)).inc(2.0, k="a")
+    reg.histogram("t_h", buckets=(1.0,)).observe(0.5)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["t_c"]["kind"] == "counter"
+    assert snap["t_c"]["samples"] == [{"labels": {"k": "a"}, "value": 2.0}]
+    assert snap["t_h"]["samples"][0]["count"] == 1
+
+
+# ----------------------------------------------------------------------
+# Prometheus text exposition (byte-stable golden)
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    reg.histogram("t_lat", "Latency", buckets=(0.5, 1.0)).observe(0.25)
+    reg.histogram("t_lat", buckets=(0.5, 1.0)).observe(0.75)
+    reg.histogram("t_lat", buckets=(0.5, 1.0)).observe(5.0)
+    ctr = reg.counter("t_requests_total", "Total requests", labels=("path",))
+    ctr.inc(2.0, path="/a")
+    ctr.inc(path="/b")
+    reg.gauge("t_temp", "Temperature").set(1.5)
+    assert render(reg) == (
+        "# HELP t_lat Latency\n"
+        "# TYPE t_lat histogram\n"
+        't_lat_bucket{le="0.5"} 1\n'
+        't_lat_bucket{le="1"} 2\n'
+        't_lat_bucket{le="+Inf"} 3\n'
+        "t_lat_sum 6\n"
+        "t_lat_count 3\n"
+        "# HELP t_requests_total Total requests\n"
+        "# TYPE t_requests_total counter\n"
+        't_requests_total{path="/a"} 2\n'
+        't_requests_total{path="/b"} 1\n'
+        "# HELP t_temp Temperature\n"
+        "# TYPE t_temp gauge\n"
+        "t_temp 1.5\n"
+    )
+
+
+# ----------------------------------------------------------------------
+# rotating JSONL sink
+
+
+def test_jsonl_sink_rotates_and_never_loses_rows(tmp_path):
+    path = tmp_path / "t.jsonl"
+    sink = JsonlSink(str(path), max_bytes=256, backups=2)
+    for i in range(20):
+        assert sink.append({"row": i, "pad": "x" * 40}, ts=float(i)) is True
+    assert sink.rows_written == 20
+    assert sink.rotations >= 1
+    assert (tmp_path / "t.jsonl.1").exists()
+    rows = []
+    for p in (path, tmp_path / "t.jsonl.1", tmp_path / "t.jsonl.2"):
+        if p.exists():
+            rows += [json.loads(ln) for ln in p.read_text().splitlines()]
+    # backups=2 bounds retention; everything retained is intact + stamped
+    assert 0 < len(rows) <= 20
+    assert all("ts" in r and "row" in r for r in rows)
+    assert sorted(r["row"] for r in rows)[-1] == 19  # newest survives
+
+
+def test_append_jsonl_one_shot(tmp_path):
+    path = tmp_path / "progress.jsonl"
+    assert append_jsonl(str(path), {"round": 7}) is True
+    row = json.loads(path.read_text().splitlines()[-1])
+    assert row["round"] == 7 and "ts" in row
+
+
+def test_jsonl_sink_coerces_numpy_rows(tmp_path):
+    path = tmp_path / "np.jsonl"
+    sink = JsonlSink(str(path))
+    assert sink.append({"loss": np.float32(0.5)}) is True
+    assert json.loads(path.read_text())["loss"] == 0.5
+
+
+# ----------------------------------------------------------------------
+# rolling SLO window
+
+
+def test_slo_window_rates_and_pruning():
+    clock = {"t": 0.0}
+    w = SLOWindow(window_s=10.0, clock=lambda: clock["t"])
+    w.observe("served", latency_s=0.01)
+    w.observe("served", latency_s=0.05)
+    w.observe("shed")
+    w.observe("deadline_miss")
+    r = w.rates()
+    assert r["requests"] == 4
+    assert r["shed_rate"] == pytest.approx(0.25)
+    assert r["deadline_miss_rate"] == pytest.approx(0.25)
+    assert r["p99_s"] == pytest.approx(0.05)
+    assert r["served_count"] == 2 and r["shed_count"] == 1
+    clock["t"] = 20.0  # everything ages out of the window
+    r2 = w.rates()
+    assert r2["requests"] == 0 and r2["shed_rate"] == 0.0
+    with pytest.raises(ValueError, match="outcome"):
+        w.observe("exploded")
+
+
+def test_slo_window_gauges_read_live_window():
+    clock = {"t": 0.0}
+    w = SLOWindow(window_s=10.0, clock=lambda: clock["t"])
+    reg = MetricsRegistry()
+    w.register_gauges(reg)
+    w.observe("shed")
+    assert reg.gauge("gymfx_serve_slo_shed_rate").value() == 1.0
+    assert reg.gauge("gymfx_serve_slo_requests").value() == 1.0
+    clock["t"] = 20.0
+    assert reg.gauge("gymfx_serve_slo_shed_rate").value() == 0.0
+
+
+# ----------------------------------------------------------------------
+# span tracing
+
+
+def test_tracer_nested_spans_ids_and_histogram():
+    reg = MetricsRegistry()
+    tr = Tracer(enabled=True, registry=reg, use_jax_annotation=False)
+    with tr.span("outer", k=4):
+        with tr.span("inner"):
+            pass
+    inner, outer = list(tr.records)[-2:]
+    assert inner["span"] == "inner" and outer["span"] == "outer"
+    assert outer["parent_id"] is None
+    assert inner["parent_id"] == outer["span_id"]
+    assert inner["trace_id"] == outer["trace_id"] == outer["span_id"]
+    assert outer["attrs"] == {"k": 4}
+    hist = reg.histogram(
+        "gymfx_span_seconds", labels=("span",), buckets=SPAN_BUCKETS
+    )
+    assert hist.snapshot(span="inner")["count"] == 1
+    assert hist.snapshot(span="outer")["count"] == 1
+
+
+def test_tracer_records_errors_and_sink_rows(tmp_path):
+    sink = JsonlSink(str(tmp_path / "spans.jsonl"))
+    tr = Tracer(enabled=True, sink=sink, use_jax_annotation=False)
+    with pytest.raises(RuntimeError):
+        with tr.span("boom"):
+            raise RuntimeError("x")
+    row = json.loads((tmp_path / "spans.jsonl").read_text().splitlines()[-1])
+    assert row["kind"] == "span" and row["span"] == "boom"
+    assert row["error"] is True
+
+
+def test_disabled_tracer_is_shared_noop():
+    tr = null_tracer()
+    assert tr.span("a") is tr.span("b")  # the one shared null span
+    with tr.span("a"):
+        pass
+    assert len(tr.records) == 0
+
+
+# ----------------------------------------------------------------------
+# on-device metric stream drains (and the DelayedLogger off path)
+
+
+def test_device_stream_holds_one_dispatch_then_drains_to_registry():
+    reg = MetricsRegistry()
+    s = DeviceMetricStream("ppo", iters=4, registry=reg, steps_per_iter=100)
+    s.after_dispatch(0, 2, {
+        "nonfinite_skips": np.array([1.0, 2.0]),
+        "loss": np.array([0.5, 0.25]),
+    })
+    # one dispatch behind: nothing materialized yet
+    ctr = reg.counter("gymfx_train_nonfinite_skips_total", labels=("algo",))
+    assert ctr.value(algo="ppo") == 0.0
+    s.after_dispatch(2, 2, {
+        "nonfinite_skips": np.array([0.0, 1.0]),
+        "loss": np.array([0.125, 0.0625]),
+    })
+    assert ctr.value(algo="ppo") == 3.0  # first dispatch: summed over k
+    s.finish()
+    assert ctr.value(algo="ppo") == 4.0
+    gauge = reg.gauge("gymfx_train_metric", labels=("algo", "metric"))
+    assert gauge.value(algo="ppo", metric="loss") == 0.0625  # newest
+    iters = reg.counter("gymfx_train_iterations_total", labels=("algo",))
+    steps = reg.counter("gymfx_train_env_steps_total", labels=("algo",))
+    assert iters.value(algo="ppo") == 4.0
+    assert steps.value(algo="ppo") == 400.0
+
+
+def test_device_stream_sink_row_per_drained_dispatch(tmp_path):
+    sink = JsonlSink(str(tmp_path / "train.jsonl"))
+    s = DeviceMetricStream("impala", iters=2, sink=sink)
+    s.after_dispatch(0, 2, {"loss": np.array([1.0, 2.0])})
+    s.finish()
+    row = json.loads((tmp_path / "train.jsonl").read_text().splitlines()[-1])
+    assert row == pytest.approx(
+        {"kind": "train_metrics", "algo": "impala", "iter": 2, "iters": 2,
+         "loss": 2.0, "ts": row["ts"]}
+    )
+
+
+def test_device_stream_print_format_matches_delayed_logger(capsys):
+    lines = []
+    s = DeviceMetricStream("ppo", iters=4, log_every=2, printer=lines.append)
+    s.after_dispatch(0, 2, {"loss": np.array([1.0, 2.0])})
+    assert lines == []  # held until the next dispatch is in flight
+    s.after_dispatch(2, 2, {"loss": np.array([3.0, 4.0])})
+    s.finish()
+    dl = DelayedLogger("ppo", 2, 4)
+    dl.after_dispatch(0, 2, {"loss": np.array([1.0, 2.0])})
+    dl.after_dispatch(2, 2, {"loss": np.array([3.0, 4.0])})
+    dl.finish()
+    assert capsys.readouterr().out.splitlines() == lines
+    assert lines == [
+        "[ppo] iter 2/4 {'loss': 2.0}",
+        "[ppo] iter 4/4 {'loss': 4.0}",
+    ]
+
+
+def test_device_stream_off_path_holds_nothing():
+    # no registry, no sink, log_every=0 — the pre-telemetry loop: the
+    # stream must not retain device arrays (that would pin memory and
+    # change donation behavior)
+    s = DeviceMetricStream("ppo", iters=8)
+    s.after_dispatch(0, 2, {"loss": np.array([1.0, 2.0])})
+    assert s._held is None
+    dl = DelayedLogger("ppo", 0, 8)
+    dl.after_dispatch(0, 2, {"loss": np.array([1.0, 2.0])})
+    assert dl._held is None
+
+
+# ----------------------------------------------------------------------
+# satellite: ResilientLoop flushes delayed loggers on every exit path
+
+
+def _state_fn():
+    return {}, None
+
+
+def test_resilient_loop_flushes_loggers_on_preemption():
+    from gymfx_tpu.resilience.faults import SimulatedPreemptionError
+    from gymfx_tpu.resilience.loop import ResilientLoop
+
+    reg = MetricsRegistry()
+    stream = DeviceMetricStream("ppo", iters=8, registry=reg)
+    loop = ResilientLoop(
+        steps_per_iter=10, max_consecutive_skips=0, preempt_at=4,
+        loggers=(stream,),
+    )
+    # trainer order: the logger takes the dispatch BEFORE the hooks run,
+    # so an aborting hook flushes a logger that already holds it
+    stream.after_dispatch(0, 2, {"loss": np.array([1.0, 2.0])})
+    loop.after_superstep(0, 2, {}, _state_fn)
+    stream.after_dispatch(2, 2, {"loss": np.array([3.0, 4.0])})
+    with pytest.raises(SimulatedPreemptionError):
+        loop.after_superstep(2, 2, {}, _state_fn)
+    gauge = reg.gauge("gymfx_train_metric", labels=("algo", "metric"))
+    # the KILLED superstep's metrics made it out before the raise
+    assert gauge.value(algo="ppo", metric="loss") == 4.0
+    iters = reg.counter("gymfx_train_iterations_total", labels=("algo",))
+    assert iters.value(algo="ppo") == 4.0
+
+
+def test_resilient_loop_flushes_loggers_on_clean_finish():
+    from gymfx_tpu.resilience.loop import ResilientLoop
+
+    reg = MetricsRegistry()
+    stream = DeviceMetricStream("impala", iters=2, registry=reg)
+    loop = ResilientLoop(
+        steps_per_iter=10, max_consecutive_skips=0, loggers=(stream,)
+    )
+    stream.after_dispatch(0, 2, {"loss": np.array([1.0, 2.0])})
+    loop.after_superstep(0, 2, {}, _state_fn)
+    loop.finish(_state_fn)
+    iters = reg.counter("gymfx_train_iterations_total", labels=("algo",))
+    assert iters.value(algo="impala") == 2.0
+
+
+def test_resilient_loop_logger_failure_does_not_mask_finish():
+    from gymfx_tpu.resilience.loop import ResilientLoop
+
+    class ExplodingLogger:
+        def finish(self):
+            raise RuntimeError("drain failed")
+
+    loop = ResilientLoop(
+        steps_per_iter=1, max_consecutive_skips=0,
+        loggers=(ExplodingLogger(),),
+    )
+    loop.finish(_state_fn)  # must not raise
+
+
+# ----------------------------------------------------------------------
+# resilience counters in the registry (one consistent view)
+
+
+def test_register_resilience_binds_live_objects():
+    from gymfx_tpu.resilience.retry import CircuitBreaker, RetryBudget
+
+    reg = MetricsRegistry()
+    budget = RetryBudget(4)
+    breaker = CircuitBreaker(2, recovery_time=60.0)
+    register_resilience(reg, budget=budget, breaker=breaker, name="serve")
+    used = reg.gauge("gymfx_resilience_retry_budget_used", labels=("name",))
+    state = reg.gauge("gymfx_resilience_breaker_state", labels=("name",))
+    assert used.value(name="serve") == 0.0
+    assert state.value(name="serve") == 0.0  # closed
+    budget.take()
+    breaker.record_failure()
+    breaker.record_failure()  # threshold 2: trips open
+    assert used.value(name="serve") == 1.0  # live read, not a mirror
+    assert state.value(name="serve") == 2.0  # open
+    snap = resilience_snapshot(reg)
+    assert snap["retry_budget_used_serve"] == 1.0
+    assert snap["breaker_state_serve"] == 2.0
+    assert snap["breaker_trips_total_serve"] == 1.0
+
+
+# ----------------------------------------------------------------------
+# telemetry_from_config: the off path is None
+
+
+def test_telemetry_from_config_all_knobs_unset_is_none():
+    from gymfx_tpu.config import DEFAULT_VALUES
+
+    assert telemetry_from_config(dict(DEFAULT_VALUES)) is None
+    assert telemetry_from_config({}) is None
+    # negative port is the explicit "no endpoint" spelling
+    assert telemetry_from_config({"telemetry_http_port": -1}) is None
+
+
+def test_telemetry_from_config_knobs(tmp_path):
+    t = telemetry_from_config({"telemetry_enabled": True})
+    assert t is not None and t.sink is None and not t.tracer.enabled
+    t2 = telemetry_from_config(
+        {"telemetry_jsonl": str(tmp_path / "t.jsonl"),
+         "telemetry_spans": True}
+    )
+    assert t2.sink is not None and t2.tracer.enabled
+    with t2.span("check"):
+        pass
+    assert list(t2.tracer.records)[-1]["span"] == "check"
+    t3 = telemetry_from_config(
+        {"telemetry_enabled": True, "telemetry_http_port": 0}
+    )
+    assert t3.http_port == 0
+    server = t3.start_http()
+    try:
+        assert server is t3.start_http()  # idempotent
+        assert server.port > 0
+    finally:
+        t3.close()
+
+
+# ----------------------------------------------------------------------
+# analytic MFU / memory accounting
+
+
+def test_analytic_flop_model():
+    from gymfx_tpu.telemetry.mfu import (
+        analytic_train_step_flops,
+        attention_flops_per_sample,
+        mfu_report,
+        param_flops_per_sample,
+    )
+
+    params = {
+        "w1": np.zeros((4, 8)), "b1": np.zeros((8,)),
+        "w2": np.zeros((8, 2)),
+    }
+    fwd = 2.0 * (4 * 8 + 8 * 2)  # biases ignored
+    assert param_flops_per_sample(params) == fwd
+    assert param_flops_per_sample(params, tokens=3) == 3 * fwd
+    assert attention_flops_per_sample(4, 8, 2) == 4.0 * 2 * 16 * 8
+    total = analytic_train_step_flops(
+        params, num_envs=2, horizon=3, update_epochs=2
+    )
+    samples = 2 * 3
+    assert total == samples * fwd + 3.0 * samples * fwd * 2
+    # the report always carries the full key set (the bench contract),
+    # null where the backend cannot say
+    import jax
+
+    report = mfu_report(total, 0.001, jax.devices()[0])
+    for key in ("analytic_flops_per_step", "hw_flops_peak",
+                "mfu_analytic", "device_memory_bytes"):
+        assert key in report
+    assert report["analytic_flops_per_step"] == total
+    assert mfu_report(None, None)["analytic_flops_per_step"] is None
+
+
+# ----------------------------------------------------------------------
+# serving end-to-end: instrumented batcher -> /metrics scrape
+
+
+def test_serve_metrics_endpoint_reflects_burst():
+    from test_serve_overload import FakeEngine, _rows
+
+    from gymfx_tpu.serve.batcher import MicroBatcher
+    from gymfx_tpu.serve.overload import ShedError
+    from gymfx_tpu.telemetry.http import TelemetryServer, scrape
+    from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+    eng = FakeEngine()
+    eng.gate.clear()
+    reg = MetricsRegistry()
+    instr = ServeInstruments(reg, slo=SLOWindow(window_s=60.0), name="e2e")
+    mb = MicroBatcher(
+        eng, max_batch_wait_ms=0.0, max_queue=2, instruments=instr
+    )
+    try:
+        f0 = mb.submit(_rows(1)[0])  # occupies the worker at the gate
+        deadline = time.perf_counter() + 5.0
+        while eng.dispatch_count == 0:
+            if time.perf_counter() > deadline:
+                raise AssertionError("worker never reached dispatch")
+            time.sleep(0.001)
+        rows = _rows(3, seed=11)
+        f1, f2 = mb.submit(rows[0]), mb.submit(rows[1])
+        with pytest.raises(ShedError):  # queue at capacity: shed
+            mb.submit(rows[2])
+        eng.gate.set()
+        for f in (f0, f1, f2):
+            f.result(timeout=30)
+        # drain the worker's completion hooks before scraping
+        deadline = time.perf_counter() + 5.0
+        while instr.requests.value(batcher="e2e", outcome="served") < 3:
+            if time.perf_counter() > deadline:
+                break
+            time.sleep(0.001)
+        with TelemetryServer(reg, health_fn=mb.health, port=0) as server:
+            text = scrape(server.url + "/metrics")
+            assert (
+                'gymfx_serve_requests_total{batcher="e2e",outcome="served"} 3'
+                in text
+            )
+            assert (
+                'gymfx_serve_shed_total{batcher="e2e",reason="queue_full"} 1'
+                in text
+            )
+            assert 'gymfx_serve_queue_depth{batcher="e2e"} 0' in text
+            assert "gymfx_serve_latency_seconds_bucket" in text
+            assert "gymfx_serve_slo_shed_rate" in text
+            health = json.loads(scrape(server.url + "/healthz"))
+            assert health["shed_count"] == 1.0
+            assert health["slo"]["requests"] == 4.0
+            assert health["slo"]["shed_rate"] > 0.0
+            assert scrape(server.url + "/metrics").startswith("# HELP")
+    finally:
+        mb.close()
+
+
+def test_serve_metrics_reflect_scripted_flaky_burst():
+    from test_serve_overload import FakeEngine, _rows
+
+    from gymfx_tpu.resilience.faults import FlakyEngine, InjectedDispatchError
+    from gymfx_tpu.serve.batcher import MicroBatcher
+    from gymfx_tpu.telemetry.http import TelemetryServer, scrape
+    from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+    # scripted dispatch-fault plan: exc, ok, exc, ok — two whole-batch
+    # failures interleaved with two served requests, deterministically
+    flaky = FlakyEngine(
+        FakeEngine(), plan=["exc", "ok", "exc", "ok"], sleep=lambda s: None
+    )
+    reg = MetricsRegistry()
+    instr = ServeInstruments(reg, slo=SLOWindow(window_s=60.0), name="flaky")
+    mb = MicroBatcher(flaky, max_batch_wait_ms=0.0, instruments=instr)
+    try:
+        outcomes = {"served": 0, "failed": 0}
+        for i in range(4):
+            try:
+                mb.submit(_rows(1, seed=20 + i)[0]).result(timeout=30)
+                outcomes["served"] += 1
+            except InjectedDispatchError:
+                outcomes["failed"] += 1
+        assert outcomes == {"served": 2, "failed": 2}
+        deadline = time.perf_counter() + 5.0  # drain completion hooks
+        while (
+            instr.requests.value(batcher="flaky", outcome="served") < 2
+            or instr.requests.value(batcher="flaky", outcome="failed") < 2
+        ):
+            if time.perf_counter() > deadline:
+                break
+            time.sleep(0.001)
+        with TelemetryServer(reg, port=0) as server:
+            text = scrape(server.url + "/metrics")
+        assert (
+            'gymfx_serve_requests_total{batcher="flaky",outcome="failed"} 2'
+            in text
+        )
+        assert (
+            'gymfx_serve_requests_total{batcher="flaky",outcome="served"} 2'
+            in text
+        )
+        assert 'gymfx_serve_dispatch_failures_total{batcher="flaky"} 2' in text
+        assert 'gymfx_serve_dispatches_total{batcher="flaky"} 2' in text
+    finally:
+        mb.close()
+
+
+def test_uninstrumented_batcher_has_no_instrument_hooks():
+    # the serving off path: no instruments object, plain-int counters
+    from test_serve_overload import FakeEngine, _rows
+
+    from gymfx_tpu.serve.batcher import MicroBatcher
+
+    mb = MicroBatcher(FakeEngine(), max_batch_wait_ms=0.0)
+    try:
+        assert mb._instr is None
+        mb.submit(_rows(1)[0]).result(timeout=30)
+        assert "slo" not in mb.health()
+    finally:
+        mb.close()

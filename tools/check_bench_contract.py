@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Validate benchmark JSON lines against the committed contract schema.
+
+The dashboards parse ``bench.py`` / ``bench_infer.py`` output
+unconditionally, so a silently dropped or renamed key is a breakage the
+emitting commit never sees.  This tool pins the key set:
+
+    python bench.py --quick | python tools/check_bench_contract.py
+    python tools/check_bench_contract.py results.jsonl ...
+
+Reads JSON lines from the given files (or stdin), takes each file's
+LAST non-empty line (the bench contract: the final stdout line is the
+record), and validates it against ``bench_contract_schema.json`` next
+to this script.  Exits non-zero with a per-violation report.
+
+The bench smoke tests import :func:`validate_record` directly, so the
+schema file is enforced inside tier-1 as well.
+"""
+from __future__ import annotations
+
+import json
+import math
+import sys
+from pathlib import Path
+from typing import Any, Dict, List
+
+SCHEMA_PATH = Path(__file__).resolve().parent / "bench_contract_schema.json"
+
+
+def load_schema() -> Dict[str, Any]:
+    with open(SCHEMA_PATH, encoding="utf-8") as fh:
+        schema = json.load(fh)
+    schema.pop("_comment", None)
+    return schema
+
+
+def _is_finite_number(value: Any) -> bool:
+    return (
+        isinstance(value, (int, float))
+        and not isinstance(value, bool)
+        and math.isfinite(float(value))
+    )
+
+
+def validate_record(record: Dict[str, Any],
+                    schema: Dict[str, Any] | None = None) -> List[str]:
+    """Return a list of violations (empty = record conforms)."""
+    if schema is None:
+        schema = load_schema()
+    problems: List[str] = []
+    if not isinstance(record, dict):
+        return [f"record is not a JSON object: {type(record).__name__}"]
+    metric = record.get("metric")
+    spec = schema.get(metric)
+    if spec is None:
+        return [
+            f"unknown metric {metric!r}; schema knows {sorted(schema)}"
+        ]
+    for key in spec.get("required", ()):
+        if key not in record:
+            problems.append(f"missing required key {key!r}")
+    for key in spec.get("numeric", ()):
+        if key in record and not _is_finite_number(record[key]):
+            problems.append(
+                f"key {key!r} must be a finite number, got {record[key]!r}"
+            )
+    for key in spec.get("numeric_or_null", ()):
+        if key in record and record[key] is not None \
+                and not _is_finite_number(record[key]):
+            problems.append(
+                f"key {key!r} must be a finite number or null, "
+                f"got {record[key]!r}"
+            )
+    for key in spec.get("object", ()):
+        if key in record and not isinstance(record[key], dict):
+            problems.append(
+                f"key {key!r} must be a JSON object, got {record[key]!r}"
+            )
+    return problems
+
+
+def check_text(text: str, source: str = "<stdin>") -> List[str]:
+    lines = [ln for ln in text.strip().splitlines() if ln.strip()]
+    if not lines:
+        return [f"{source}: no output to validate"]
+    try:
+        record = json.loads(lines[-1])
+    except json.JSONDecodeError as exc:
+        return [f"{source}: last line is not JSON: {exc}"]
+    return [f"{source}: {p}" for p in validate_record(record)]
+
+
+def main(argv: List[str]) -> int:
+    problems: List[str] = []
+    if len(argv) > 1:
+        for path in argv[1:]:
+            problems += check_text(
+                Path(path).read_text(encoding="utf-8"), source=path
+            )
+    else:
+        problems += check_text(sys.stdin.read())
+    if problems:
+        for p in problems:
+            print(f"BENCH CONTRACT VIOLATION: {p}", file=sys.stderr)
+        return 1
+    print("bench contract OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

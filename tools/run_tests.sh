@@ -15,7 +15,59 @@
 # error: Segmentation fault" during garbage collection or tracing,
 # suspect a shared/stale JAX_COMPILATION_CACHE_DIR leaking in from
 # the environment before blaming the test that happened to be running.
-set -euo pipefail
+#
+# After the suite: a telemetry smoke (ephemeral /metrics endpoint,
+# one scrape, assert non-empty — docs/observability.md) and a per-run
+# summary row appended to PROGRESS.jsonl through the JSONL sink.
+set -uo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
-    --continue-on-collection-errors -p no:cacheprovider "$@"
+
+start=$(date +%s)
+rc=0
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider "$@" || rc=$?
+wall=$(( $(date +%s) - start ))
+
+# telemetry smoke + PROGRESS row (registry/http/sink are jax-free:
+# this is sub-second and runs even when the suite failed, so the row
+# records the failure too)
+smoke_rc=0
+python - "$rc" "$wall" <<'EOF' || smoke_rc=$?
+import subprocess
+import sys
+
+from gymfx_tpu.telemetry import MetricsRegistry
+from gymfx_tpu.telemetry.http import TelemetryServer, scrape
+from gymfx_tpu.telemetry.sink import append_jsonl
+
+rc, wall = int(sys.argv[1]), float(sys.argv[2])
+reg = MetricsRegistry()
+reg.counter("gymfx_smoke_runs_total", "run_tests.sh telemetry smoke").inc()
+with TelemetryServer(reg, port=0) as srv:
+    url = srv.url
+    text = scrape(url + "/metrics")
+assert text.strip(), "telemetry smoke: empty /metrics exposition"
+assert "gymfx_smoke_runs_total 1" in text, text
+print(f"telemetry smoke OK ({len(text)} bytes from {url}/metrics)")
+
+def _git_int(*args):
+    try:
+        out = subprocess.run(
+            ("git",) + args, capture_output=True, text=True, timeout=10
+        ).stdout.split()
+        return int(out[0]) if out else None
+    except Exception:
+        return None
+
+append_jsonl("PROGRESS.jsonl", {
+    "kind": "test_run",
+    "wall_s": float(wall),
+    "rc": rc,
+    "commits": _git_int("rev-list", "--count", "HEAD"),
+})
+EOF
+
+if [ "$rc" -ne 0 ]; then
+    exit "$rc"
+fi
+exit "$smoke_rc"
