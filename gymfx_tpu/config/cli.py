@@ -56,6 +56,10 @@ def parse_args(argv=None):
     parser.add_argument("--fault_profile", type=str)
     parser.add_argument("--guard_max_consecutive_skips", type=int)
 
+    # pod-scale mesh (docs/performance.md, "Scaling out"); JSON axis
+    # sizes, e.g. '{"data": 8}' or '{"data": 16, "model": 2}'
+    parser.add_argument("--mesh_shape", type=str)
+
     # dispatch / memory flags (docs/performance.md)
     parser.add_argument("--supersteps_per_dispatch", type=int)
     parser.add_argument("--stream_hbm_budget_mb", type=float)

@@ -380,9 +380,16 @@ class BarStreamer:
     """
 
     def __init__(self, host_data: MarketData, *, window_size: int,
-                 budget_mb: float, min_shard_bars: int = 64):
+                 budget_mb: float, min_shard_bars: int = 64,
+                 placement=None):
         self.host_data = host_data
         self.window_size = int(window_size)
+        # optional jax.sharding.Sharding for each shard's device_put —
+        # on a mesh the ShardedRuntime passes its replicated sharding so
+        # streamed bars land on EVERY mesh device (a bare device_put
+        # targets device 0 only, forcing an implicit transfer inside the
+        # sharded rollout program); None keeps the single-device path
+        self.placement = placement
         n = int(np.asarray(host_data.close).shape[0])
         total = market_data_nbytes(host_data)
         per_bar = max(1.0, total / max(1, n))
@@ -427,6 +434,10 @@ class BarStreamer:
         )
         # device_put on host numpy is async: it enqueues the transfer
         # and returns immediately — the double buffer.
+        if self.placement is not None:
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self.placement), shard
+            )
         return jax.tree.map(jax.device_put, shard)
 
     def iter_shards(self):

@@ -3,6 +3,11 @@ from gymfx_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     mesh_from_config,
     validate_batch_axis,
+    validate_population_axis,
     batch_sharding,
     replicated_sharding,
+)
+from gymfx_tpu.parallel.runtime import (  # noqa: F401
+    ShardedRuntime,
+    StatePlan,
 )

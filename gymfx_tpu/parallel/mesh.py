@@ -141,6 +141,28 @@ def validate_batch_axis(mesh: Optional[Mesh], n: int, what: str,
         )
 
 
+def validate_population_axis(mesh: Optional[Mesh], population: int,
+                             axis: str = "data") -> None:
+    """PBT shards its POPULATION (not the env batch) over the mesh
+    ``axis``; honor-or-reject before XLA, same style as
+    :func:`validate_batch_axis` — a population the mesh cannot split
+    evenly would otherwise surface as a cryptic GSPMD error."""
+    if mesh is None:
+        return
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh_shape must include a {axis!r} axis (got axes "
+            f"{list(mesh.axis_names)}): PBT shards the population over it"
+        )
+    k = mesh.shape[axis]
+    if population % k != 0:
+        raise ValueError(
+            f"pbt_population={population} is not divisible by mesh axis "
+            f"{axis!r} size {k}; PBT shards the population over {axis!r} — "
+            f"choose pbt_population as a multiple of {k}"
+        )
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

@@ -97,16 +97,19 @@ class ResilientLoop:
     def _check_pending(self, state_fn: StateFn) -> None:
         if self.monitor is None or self._pending is None:
             return
+        import jax
         import numpy as np
 
         it_start, k, guard_metrics = self._pending
         self._pending = None
         # ONE host fetch per superstep: each guard counter arrives as a
         # stacked (k,) array ((1,) for the per-step path) and the
-        # monitor replays the per-iteration deltas from it
+        # monitor replays the per-iteration deltas from it — fetched as
+        # one device_get of the whole tree so mesh-sharded counters do
+        # not gather per leaf
         host = {
             key: np.ravel(np.asarray(value))
-            for key, value in guard_metrics.items()
+            for key, value in jax.device_get(guard_metrics).items()
         }
         try:
             for j in range(k):

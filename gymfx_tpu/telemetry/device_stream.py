@@ -105,10 +105,18 @@ class DeviceMetricStream:
     def _flush(self) -> None:
         if self._held is None:
             return
+        import jax
         import numpy as np
 
         it_end, k, tree, want_print = self._held
         self._held = None
+        # ONE jax.device_get of the whole stacked tree: on a mesh the
+        # held leaves are sharded/committed jax.Arrays, and a per-leaf
+        # np.asarray would issue one cross-device gather each — this
+        # stays a single host fetch per drained dispatch (the dispatch
+        # before it has already been issued, so no hot sync either way);
+        # plain Python scalars pass through unchanged
+        tree = jax.device_get(tree)
         host = {
             key: np.ravel(np.asarray(value)) for key, value in tree.items()
         }

@@ -322,33 +322,11 @@ def shard_train_state(
     replicated: Dict[str, Any],
     batched: Dict[str, Any],
 ) -> Dict[str, Any]:
-    """Place train-state field groups on a mesh: policy params get wide
-    2-D matrices tensor-sharded over 'model' (rest replicated),
-    ``replicated`` trees replicate, ``batched`` trees shard their
-    leading env axis over 'data'.  Returns {field: placed_tree}."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    """Legacy surface: the placement plan moved to
+    :class:`~gymfx_tpu.parallel.runtime.ShardedRuntime` (one owner for
+    all four trainers); this wrapper keeps old callers working."""
+    from gymfx_tpu.parallel.runtime import ShardedRuntime
 
-    rep = NamedSharding(mesh, P())
-    batch = NamedSharding(mesh, P("data"))
-
-    def shard_param(path, x):
-        if (
-            "model" in mesh.axis_names
-            and hasattr(x, "ndim")
-            and x.ndim == 2
-            and x.shape[-1] % mesh.shape["model"] == 0
-            and x.shape[-1] >= 128
-        ):
-            return jax.device_put(x, NamedSharding(mesh, P(None, "model")))
-        return jax.device_put(x, rep)
-
-    out: Dict[str, Any] = {}
-    for name, tree in params.items():
-        out[name] = jax.tree_util.tree_map_with_path(shard_param, tree)
-    for name, tree in replicated.items():
-        out[name] = jax.tree.map(
-            lambda x: jax.device_put(x, rep) if hasattr(x, "shape") else x, tree
-        )
-    for name, tree in batched.items():
-        out[name] = jax.tree.map(lambda x: jax.device_put(x, batch), tree)
-    return out
+    return ShardedRuntime(mesh).place_groups(
+        params=params, replicated=replicated, batched=batched
+    )

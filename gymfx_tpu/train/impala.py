@@ -31,6 +31,7 @@ import optax
 
 from gymfx_tpu.core import env as env_core
 from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.parallel.runtime import ShardedRuntime, StatePlan
 from gymfx_tpu.train.common import masked_reset
 from gymfx_tpu.train.policies import (
     flatten_obs,
@@ -113,10 +114,20 @@ class ImpalaState(NamedTuple):
 
 
 class ImpalaTrainer:
+    # shared placement plan (parallel/runtime.ShardedRuntime): learner
+    # AND actor params are tensor-shard candidates, the sync counter
+    # replicates with opt/rng, the env batch shards over 'data'
+    STATE_PLAN = StatePlan(
+        params=("learner_params", "actor_params"),
+        replicated=("opt_state", "rng", "updates_since_sync"),
+        batched=("env_states", "obs_vec", "policy_carry"),
+    )
+
     def __init__(self, env: Environment, icfg: ImpalaConfig, mesh: Optional[Any] = None):
         self.env = env
         self.icfg = icfg
         self.mesh = mesh
+        self.runtime = None if mesh is None else ShardedRuntime(mesh)
         # V-trace is distribution-agnostic: continuous mode swaps in the
         # Gaussian twin via the shared construction path (only the
         # log-prob and entropy terms change, train/policies.py)
@@ -164,8 +175,8 @@ class ImpalaTrainer:
     # ------------------------------------------------------------------
     def init_state(self, seed: int = 0) -> ImpalaState:
         state = self.init_state_from_key(jax.random.PRNGKey(seed))
-        if self.mesh is not None:
-            state = self._shard_state(state)
+        if self.runtime is not None:
+            state = self.runtime.place_state(state, self.STATE_PLAN)
         return state
 
     def init_state_from_key(self, rng) -> ImpalaState:
@@ -192,22 +203,6 @@ class ImpalaTrainer:
             updates_since_sync=jnp.zeros((), jnp.int32),
         )
         return state
-
-    def _shard_state(self, state: ImpalaState) -> ImpalaState:
-        from gymfx_tpu.train.common import shard_train_state
-
-        return state._replace(
-            **shard_train_state(
-                self.mesh,
-                params={"learner_params": state.learner_params,
-                        "actor_params": state.actor_params},
-                replicated={"opt_state": state.opt_state, "rng": state.rng,
-                            "updates_since_sync": state.updates_since_sync},
-                batched={"env_states": state.env_states,
-                         "obs_vec": state.obs_vec,
-                         "policy_carry": state.policy_carry},
-            )
-        )
 
     # ------------------------------------------------------------------
     def _rollout(self, actor_params, env_states, obs_vec, pcarry, rng):
@@ -458,8 +453,8 @@ class ImpalaTrainer:
               telemetry=None):
         if initial_state is not None:
             state = initial_state
-            if self.mesh is not None:
-                state = self._shard_state(state)
+            if self.runtime is not None:
+                state = self.runtime.place_state(state, self.STATE_PLAN)
         else:
             state = self.init_state(seed)
         if initial_params is not None:
@@ -468,9 +463,9 @@ class ImpalaTrainer:
                 learner_params=initial_params,
                 actor_params=jax.tree.map(jnp.copy, initial_params),
             )
-            if self.mesh is not None:
+            if self.runtime is not None:
                 # restored host arrays must re-enter the mesh placement
-                state = self._shard_state(state)
+                state = self.runtime.place_state(state, self.STATE_PLAN)
         per_iter = self.icfg.n_envs * self.icfg.unroll
         iters = max(1, int(total_env_steps) // per_iter)
         from gymfx_tpu.resilience.loop import ResilientLoop

@@ -113,12 +113,15 @@ class PBTTrainer:
         # 'data' axis (members are embarrassingly parallel between
         # exploit/explore syncs), so P members train on P/devices chips
         # each — distinct from the single-trainer mesh, which shards the
-        # env batch of ONE member.
+        # env batch of ONE member.  Placement and the divisibility check
+        # are owned by the shared ShardedRuntime plan.
         self.mesh = mesh
+        self.runtime = None
         if mesh is not None:
-            from gymfx_tpu.parallel import validate_batch_axis
+            from gymfx_tpu.parallel import ShardedRuntime
 
-            validate_batch_axis(mesh, pbt.population, "pbt_population")
+            self.runtime = ShardedRuntime(mesh)
+            self.runtime.validate_population(pbt.population)
         self._vstep = jax.jit(jax.vmap(self.trainer._train_step_impl), donate_argnums=0)
         self._vinit = jax.jit(jax.vmap(self.trainer.init_state_from_key))
 
@@ -140,15 +143,9 @@ class PBTTrainer:
 
     def _place(self, states):
         """Shard the population axis over the mesh (no-op without one)."""
-        if self.mesh is None:
+        if self.runtime is None:
             return states
-        from gymfx_tpu.parallel import batch_sharding
-
-        pop = batch_sharding(self.mesh)
-        return jax.tree.map(
-            lambda x: jax.device_put(x, pop) if hasattr(x, "shape") else x,
-            states,
-        )
+        return self.runtime.place_population(states)
 
     def _set_hyper(self, states, key: str, values):
         opt_state = states.opt_state
@@ -296,9 +293,12 @@ def _pbt_config_from(config: Dict[str, Any]) -> PBTConfig:
 
 
 def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
-    from gymfx_tpu.parallel import mesh_from_config
+    from gymfx_tpu.parallel import mesh_from_config, validate_population_axis
 
     mesh = mesh_from_config(config)
+    # honor-or-reject at the config entry point: a population the mesh
+    # cannot split evenly fails HERE, before env construction / XLA
+    validate_population_axis(mesh, int(config.get("pbt_population", 8)))
     if config.get("portfolio_files"):
         from gymfx_tpu.train.common import (
             build_portfolio_train_eval_envs,

@@ -21,6 +21,7 @@ import numpy as np
 import optax
 
 from gymfx_tpu.core import portfolio as P
+from gymfx_tpu.parallel.runtime import ShardedRuntime, StatePlan
 from gymfx_tpu.train.common import masked_reset
 from gymfx_tpu.train.policies import RingTransformerEncoder, is_token_policy
 
@@ -161,11 +162,20 @@ def _encode_tokens(obs: Dict[str, Any], window: int):
 
 
 class PortfolioPPOTrainer:
+    # shared placement plan (parallel/runtime.ShardedRuntime); the
+    # portfolio state has no recurrent carry — otherwise identical to PPO
+    STATE_PLAN = StatePlan(
+        params=("params",),
+        replicated=("opt_state", "rng"),
+        batched=("env_states", "obs_vec"),
+    )
+
     def __init__(self, env: P.PortfolioEnvironment, pcfg: PortfolioPPOConfig,
                  mesh: Optional[Any] = None):
         self.env = env
         self.pcfg = pcfg
         self.mesh = mesh
+        self.runtime = None if mesh is None else ShardedRuntime(mesh)
         from gymfx_tpu.train.common import validate_minibatch_scheme
 
         validate_minibatch_scheme(
@@ -215,22 +225,9 @@ class PortfolioPPOTrainer:
 
     def init_state(self, seed: int = 0) -> PortfolioTrainState:
         state = self.init_state_from_key(jax.random.PRNGKey(seed))
-        if self.mesh is not None:
-            state = self._shard_state(state)
+        if self.runtime is not None:
+            state = self.runtime.place_state(state, self.STATE_PLAN)
         return state
-
-    def _shard_state(self, state: PortfolioTrainState) -> PortfolioTrainState:
-        from gymfx_tpu.train.common import shard_train_state
-
-        return state._replace(
-            **shard_train_state(
-                self.mesh,
-                params={"params": state.params},
-                replicated={"opt_state": state.opt_state, "rng": state.rng},
-                batched={"env_states": state.env_states,
-                         "obs_vec": state.obs_vec},
-            )
-        )
 
     def init_state_from_key(self, rng) -> PortfolioTrainState:
         rng, k = jax.random.split(rng)
@@ -417,16 +414,16 @@ class PortfolioPPOTrainer:
         contract as the single-pair trainers (train/ppo.py)."""
         if initial_state is not None:
             state = initial_state
-            if self.mesh is not None:
-                state = self._shard_state(state)
+            if self.runtime is not None:
+                state = self.runtime.place_state(state, self.STATE_PLAN)
         else:
             state = self.init_state(seed)
         if initial_params is not None:
             state = state._replace(params=initial_params)
-            if self.mesh is not None:
+            if self.runtime is not None:
                 # restored host arrays must re-enter the mesh placement
                 # (model-axis tensor sharding), like the full-state path
-                state = self._shard_state(state)
+                state = self.runtime.place_state(state, self.STATE_PLAN)
         per_iter = self.pcfg.n_envs * self.pcfg.horizon
         iters = max(1, int(total_env_steps) // per_iter)
         t0 = time.perf_counter()

@@ -8,7 +8,8 @@ trainer.  Design:
     buffers in host memory;
   * the env batch is data-parallel across the mesh 'data' axis (each
     device steps its shard of envs); wide policy layers may also be
-    tensor-sharded across 'model' (see shard_params);
+    tensor-sharded across 'model' — placement is owned by the shared
+    :class:`~gymfx_tpu.parallel.runtime.ShardedRuntime` plan;
   * gradients are averaged over all envs — under jit with replicated
     params and sharded batch, XLA emits the all-reduce over ICI;
   * auto-reset: terminated envs restart from a fresh reset state inside
@@ -27,6 +28,7 @@ import optax
 
 from gymfx_tpu.core import env as env_core
 from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.parallel.runtime import ShardedRuntime, StatePlan
 from gymfx_tpu.train.common import masked_reset
 from gymfx_tpu.train.policies import (
     flatten_obs,
@@ -142,10 +144,20 @@ class TrainState(NamedTuple):
 class PPOTrainer:
     """Builds the jitted train_step for (Environment, PPOConfig)."""
 
+    # shared placement plan (parallel/runtime.ShardedRuntime): params
+    # tensor-shard wide matrices over 'model', opt/rng replicate, the
+    # env batch shards its leading axis over 'data'
+    STATE_PLAN = StatePlan(
+        params=("params",),
+        replicated=("opt_state", "rng"),
+        batched=("env_states", "obs_vec", "policy_carry"),
+    )
+
     def __init__(self, env: Environment, pcfg: PPOConfig, mesh: Optional[Any] = None):
         self.env = env
         self.pcfg = pcfg
         self.mesh = mesh
+        self.runtime = None if mesh is None else ShardedRuntime(mesh)
         from gymfx_tpu.train.common import validate_minibatch_scheme
 
         validate_minibatch_scheme(
@@ -196,8 +208,8 @@ class PPOTrainer:
 
     def init_state(self, seed: int = 0) -> TrainState:
         state = self.init_state_from_key(jax.random.PRNGKey(seed))
-        if self.mesh is not None:
-            state = self._shard_state(state)
+        if self.runtime is not None:
+            state = self.runtime.place_state(state, self.STATE_PLAN)
         return state
 
     def init_state_from_key(self, rng) -> TrainState:
@@ -221,38 +233,6 @@ class PPOTrainer:
             lambda x: jnp.broadcast_to(x, (n, *x.shape)), carry0
         )
         return TrainState(p, opt_state, env_states, obs_vec, pcarry, rng)
-
-    def _shard_state(self, state: TrainState) -> TrainState:
-        """Replicate params/opt, shard the env batch over the 'data' axis,
-        and tensor-shard wide policy matrices over 'model'."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        mesh = self.mesh
-        replicated = NamedSharding(mesh, P())
-        batch = NamedSharding(mesh, P("data"))
-
-        def shard_param(path, x):
-            if (
-                "model" in mesh.axis_names
-                and x.ndim == 2
-                and x.shape[-1] % mesh.shape["model"] == 0
-                and x.shape[-1] >= 128
-            ):
-                return jax.device_put(x, NamedSharding(mesh, P(None, "model")))
-            return jax.device_put(x, replicated)
-
-        params = jax.tree_util.tree_map_with_path(shard_param, state.params)
-        opt_state = jax.tree.map(
-            lambda x: jax.device_put(x, replicated)
-            if hasattr(x, "shape")
-            else x,
-            state.opt_state,
-        )
-        env_states = jax.tree.map(lambda x: jax.device_put(x, batch), state.env_states)
-        obs_vec = jax.device_put(state.obs_vec, batch)
-        pcarry = jax.tree.map(lambda x: jax.device_put(x, batch), state.policy_carry)
-        rng = jax.device_put(state.rng, replicated)
-        return TrainState(params, opt_state, env_states, obs_vec, pcarry, rng)
 
     # ------------------------------------------------------------------
     def _policy_forward(self, params, obs_vec, pcarry):
@@ -601,16 +581,16 @@ class PPOTrainer:
         this loop is the exact pre-telemetry one."""
         if initial_state is not None:
             state = initial_state
-            if self.mesh is not None:
-                state = self._shard_state(state)
+            if self.runtime is not None:
+                state = self.runtime.place_state(state, self.STATE_PLAN)
         else:
             state = self.init_state(seed)
         if initial_params is not None:
             state = state._replace(params=initial_params)
-            if self.mesh is not None:
+            if self.runtime is not None:
                 # restored host arrays must re-enter the mesh placement
                 # (model-axis tensor sharding), like the full-state path
-                state = self._shard_state(state)
+                state = self.runtime.place_state(state, self.STATE_PLAN)
         steps_per_iter = self.pcfg.n_envs * self.pcfg.horizon
         iters = max(1, int(total_env_steps) // steps_per_iter)
         from gymfx_tpu.resilience.loop import ResilientLoop

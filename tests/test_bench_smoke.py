@@ -8,6 +8,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO / "tools"))
 
@@ -43,3 +45,39 @@ def test_bench_quick_prints_single_json_line_contract():
     for key in ("rollout_ms", "update_ms"):
         assert key in payload, (key, payload)
         assert payload[key] is not None and payload[key] > 0, (key, payload)
+
+
+@pytest.mark.slow
+def test_multichip_bench_quick_emits_schema_valid_scaling_row():
+    """tools/multichip_bench.py --quick on the 8-virtual-device CPU
+    mesh: the final stdout line is a schema-valid multichip record with
+    real aggregate/scaling numbers — the row the MULTICHIP harness
+    emits (same build_record code path).  Slow-marked: the subprocess
+    compiles its own sharded programs (~40s); the tier-1 schema gate on
+    multichip rows is the MULTICHIP harness's own validate_record
+    assert (__graft_entry__.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gymfx_jax_cache")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "multichip_bench.py"),
+         "--quick"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    payload = json.loads(lines[-1])
+    problems = validate_record(payload)
+    assert not problems, (problems, payload)
+    assert payload["metric"] == "multichip_env_steps_per_sec"
+    assert payload["aggregate_steps_per_sec"] > 0
+    assert payload["single_device_steps_per_sec"] > 0
+    assert payload["scaling_efficiency"] > 0
+    assert payload["n_devices"] == 8
+    assert payload["mesh_shape"] == {"data": 8}
+    # off-TPU the anchor comparison and MFU are null, never fabricated
+    assert payload["vs_single_chip_anchor"] is None
+    assert payload["mfu_analytic"] is None

@@ -1,0 +1,298 @@
+"""Pod-scale sharded runtime (gymfx_tpu/parallel/runtime.py): one
+ShardedRuntime owns the mesh + NamedSharding plan for all four
+trainers.  Pinned here, on the 8-virtual-device CPU mesh (conftest):
+
+  * a mesh-sharded PPO/IMPALA superstep (train_many through the shared
+    plan) matches the unsharded trainer numerically;
+  * a sharded run preempted at a superstep boundary resumes from the
+    mesh checkpoint BIT-identically (the plan round-trips restores);
+  * PBT population divisibility is honor-or-reject before any XLA;
+  * runtime.bar_streamer places streamed market-data shards on EVERY
+    mesh device (not device 0 only);
+  * zero-sized leaves are placed replicated — XLA returns them
+    replicated from every compiled program regardless of the input
+    spec, and the AOT executables reject mismatched input shardings.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+from gymfx_tpu.parallel import (
+    ShardedRuntime,
+    StatePlan,
+    make_mesh,
+    validate_population_axis,
+)
+from tests.helpers import uptrend_df
+
+needs_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _env(n_bars=120, **over):
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=16, ppo_horizon=8,
+                  ppo_epochs=1, ppo_minibatches=2,
+                  policy_kwargs={"hidden": [128, 128]})
+    config.update(over)
+    df = uptrend_df(n_bars)
+    return Environment(config, dataset=MarketDataset(df, config)), config
+
+
+def _ppo(mesh=None, **over):
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    env, config = _env(**over)
+    return PPOTrainer(env, ppo_config_from(config), mesh=mesh)
+
+
+def _impala(mesh=None, **over):
+    from gymfx_tpu.train.impala import ImpalaTrainer, impala_config_from
+
+    over.setdefault("impala_unroll", 8)
+    over.setdefault("policy", "mlp")
+    env, config = _env(**over)
+    return ImpalaTrainer(env, impala_config_from(config), mesh=mesh)
+
+
+def _assert_trees_close(a, b, what, rtol=5e-4, atol=1e-5):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), what
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol,
+            err_msg=f"{what} leaf {i}",
+        )
+
+
+# ---------------------------------------------------------------------------
+# numerical parity: mesh-sharded superstep vs unsharded
+#
+# Parity is pinned on the DATA mesh — the scaling configuration the
+# multichip bench rows measure.  On the data axis the GSPMD program is
+# the same math with an all-reduce, so the trajectory matches to
+# reduction-order noise (actions bit-identical).  The model axis is
+# pinned separately at the forward level: tensor-sharded matmul
+# partials perturb logits at the ulp level, and categorical SAMPLING
+# amplifies near-ties into different actions — trajectory-level
+# equality is not a property tensor parallelism has (DIVERGENCES.md).
+# ---------------------------------------------------------------------------
+@needs_8_devices
+def test_ppo_sharded_superstep_matches_unsharded():
+    """Same seed, K=2 train_many over data=8: the sharded superstep
+    reproduces the single-device trajectory to all-reduce noise."""
+    mesh = make_mesh({"data": 8})
+    # small net: data-axis parity doesn't need the wide-matrix rule,
+    # and tier-1 pays these compiles cold
+    tr_ref = _ppo(policy_kwargs={"hidden": [32, 32]})
+    tr_mesh = _ppo(mesh=mesh, policy_kwargs={"hidden": [32, 32]})
+    s_ref, m_ref = tr_ref.train_many(tr_ref.init_state(0), 2)
+    s_mesh, m_mesh = tr_mesh.train_many(tr_mesh.init_state(0), 2)
+    # the sharded state really is sharded (not silently replicated)
+    assert s_mesh.obs_vec.sharding.spec == P("data")
+    _assert_trees_close(s_ref.params, s_mesh.params, "ppo params")
+    _assert_trees_close(s_ref.env_states, s_mesh.env_states, "ppo envs")
+    assert set(m_ref) == set(m_mesh)
+    for key in m_ref:
+        np.testing.assert_allclose(
+            np.asarray(m_ref[key]), np.asarray(m_mesh[key]),
+            rtol=1e-4, atol=1e-5, err_msg=key,
+        )
+
+
+@needs_8_devices
+def test_impala_sharded_superstep_matches_unsharded():
+    mesh = make_mesh({"data": 8})
+    tr_ref = _impala(policy_kwargs={"hidden": [32, 32]})
+    tr_mesh = _impala(mesh=mesh, policy_kwargs={"hidden": [32, 32]})
+    s_ref, m_ref = tr_ref.train_many(tr_ref.init_state(0), 2)
+    s_mesh, m_mesh = tr_mesh.train_many(tr_mesh.init_state(0), 2)
+    assert s_mesh.obs_vec.sharding.spec == P("data")
+    _assert_trees_close(
+        s_ref.learner_params, s_mesh.learner_params, "impala params"
+    )
+    for key in m_ref:
+        np.testing.assert_allclose(
+            np.asarray(m_ref[key]), np.asarray(m_mesh[key]),
+            rtol=1e-4, atol=1e-5, err_msg=key,
+        )
+
+
+@needs_8_devices
+def test_model_axis_forward_matches_replicated():
+    """Tensor parallelism pinned where it IS deterministic: the policy
+    forward on plan-placed (P(None,'model')-sharded) params matches the
+    replicated forward on the same obs to float32 matmul noise, and a
+    full data x model train step stays finite and correctly sharded."""
+    mesh = make_mesh({"data": 4, "model": 2})
+    tr = _ppo(mesh=mesh)
+    state = tr.init_state(0)
+    # the wide hidden matrices really are tensor-sharded
+    specs = {
+        tuple(x.shape): x.sharding.spec
+        for x in jax.tree.leaves(state.params)
+    }
+    assert specs[(128, 128)] == P(None, "model")
+    host_params = jax.device_get(state.params)
+    obs = np.asarray(state.obs_vec)
+    logits_sharded, value_sharded = tr.policy.apply(
+        state.params, state.obs_vec
+    )
+    logits_ref, value_ref = tr.policy.apply(host_params, obs)
+    np.testing.assert_allclose(
+        np.asarray(logits_sharded), np.asarray(logits_ref),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(value_sharded), np.asarray(value_ref),
+        rtol=1e-5, atol=1e-6,
+    )
+    state, metrics = tr.train_step(state)
+    assert all(np.isfinite(float(np.asarray(v))) for v in metrics.values())
+    assert state.obs_vec.sharding.spec == P("data")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip through the sharding plan
+# ---------------------------------------------------------------------------
+@needs_8_devices
+@pytest.mark.slow
+def test_mesh_checkpoint_resume_bit_identical(tmp_path):
+    """Preempt a SHARDED K=2 run at a superstep boundary; resume from
+    the boundary checkpoint through runtime.place_state.  Final params
+    must be bit-identical to the uninterrupted sharded run — the plan
+    places the restored host arrays exactly as the saving run did."""
+    from gymfx_tpu.resilience.faults import SimulatedPreemptionError
+    from gymfx_tpu.train.checkpoint import load_checkpoint
+
+    # same opt-out as the single-device drill: the triple-run shape
+    # segfaults deserializing from the warm persistent compile cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        mesh = make_mesh({"data": 4})
+        tr = _ppo(mesh=mesh)
+        spi = 16 * 8  # num_envs * horizon
+        total = spi * 4
+        s_ref, _ = tr.train(total, seed=3, supersteps_per_dispatch=2)
+        ref_leaves = [
+            np.asarray(x).copy() for x in jax.tree.leaves(s_ref.params)
+        ]
+        with pytest.raises(SimulatedPreemptionError):
+            tr.train(total, seed=3, supersteps_per_dispatch=2,
+                     checkpoint_dir=str(tmp_path), checkpoint_every=2,
+                     preempt_at=2)
+        template = tr.init_state(3)
+        state, step = load_checkpoint(str(tmp_path), template=template)
+        assert step == 2 * spi
+        s_res, _ = tr.train(
+            total - step, seed=3, initial_state=state, step_offset=step,
+            supersteps_per_dispatch=2,
+        )
+        assert jax.tree.leaves(s_res.params)[0].sharding.mesh.shape == \
+            mesh.shape
+        for i, (a, b) in enumerate(
+            zip(ref_leaves, jax.tree.leaves(s_res.params))
+        ):
+            np.testing.assert_array_equal(
+                a, np.asarray(b), err_msg=f"leaf {i}"
+            )
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+
+
+# ---------------------------------------------------------------------------
+# PBT population over the data axis: honor-or-reject
+# ---------------------------------------------------------------------------
+@needs_8_devices
+def test_pbt_population_divisibility_rejected_before_xla():
+    from gymfx_tpu.train.pbt import PBTConfig, PBTTrainer
+    from gymfx_tpu.train.ppo import ppo_config_from
+
+    env, config = _env(num_envs=4, policy_kwargs={"hidden": [16, 16]})
+    mesh = make_mesh({"data": 4})
+    with pytest.raises(ValueError, match="not divisible"):
+        PBTTrainer(env, ppo_config_from(config),
+                   PBTConfig(population=6, interval=1), mesh=mesh)
+    # a divisible population constructs fine
+    PBTTrainer(env, ppo_config_from(config),
+               PBTConfig(population=8, interval=1), mesh=mesh)
+
+
+@needs_8_devices
+def test_pbt_population_rejected_without_data_axis():
+    mesh = make_mesh({"model": 2})
+    with pytest.raises(ValueError, match="data"):
+        validate_population_axis(mesh, 4)
+    # no mesh -> no constraint
+    validate_population_axis(None, 7)
+
+
+@needs_8_devices
+def test_pbt_from_config_rejects_population_at_entry():
+    """The config entry point fails BEFORE env construction (no CSV is
+    ever read): honor-or-reject on pbt_population % data."""
+    from gymfx_tpu.train.pbt import train_pbt_from_config
+
+    config = dict(DEFAULT_VALUES)
+    config.update(mesh_shape='{"data": 8}', pbt_population=6,
+                  input_data_file="/nonexistent/never_read.csv")
+    with pytest.raises(ValueError, match="pbt_population"):
+        train_pbt_from_config(config)
+
+
+# ---------------------------------------------------------------------------
+# sharded host->device bar streaming
+# ---------------------------------------------------------------------------
+@needs_8_devices
+def test_runtime_bar_streamer_places_shards_on_all_mesh_devices():
+    env, _ = _env(n_bars=400)
+    runtime = ShardedRuntime(make_mesh({"data": 4, "model": 2}))
+    streamer = runtime.bar_streamer(
+        env.data, window_size=8, budget_mb=0.01, min_shard_bars=64
+    )
+    assert streamer.num_shards >= 2
+    shard = streamer._device_shard(0)
+    for leaf in jax.tree.leaves(shard):
+        assert len(leaf.sharding.device_set) == 8, leaf.sharding
+        assert leaf.sharding.spec == P()
+
+
+# ---------------------------------------------------------------------------
+# the plan itself
+# ---------------------------------------------------------------------------
+@needs_8_devices
+def test_place_batched_keeps_zero_sized_leaves_replicated():
+    runtime = ShardedRuntime(make_mesh({"data": 8}))
+    import jax.numpy as jnp
+
+    tree = {"full": jnp.zeros((16, 4)), "empty": jnp.zeros((16, 8, 0))}
+    placed = runtime.place_batched(tree)
+    assert placed["full"].sharding.spec == P("data")
+    assert placed["empty"].sharding.spec == P()
+
+
+@needs_8_devices
+def test_runtime_plan_and_validation():
+    runtime = ShardedRuntime(make_mesh({"data": 4, "model": 2}))
+    assert runtime.n_devices == 8
+    assert runtime.mesh_shape == {"data": 4, "model": 2}
+    desc = runtime.describe()
+    assert desc["n_devices"] == 8 and "plan" in desc
+    with pytest.raises(ValueError):
+        runtime.validate_batch(6, "num_envs")  # 6 % 4 != 0
+    with pytest.raises(ValueError):
+        ShardedRuntime(None)
+    assert ShardedRuntime.from_config(dict(DEFAULT_VALUES)) is None
+    # params plan: wide 2-D matrices tensor-shard, the rest replicate
+    import jax.numpy as jnp
+
+    wide = runtime._param_sharding(jnp.zeros((64, 128)))
+    narrow = runtime._param_sharding(jnp.zeros((64, 6)))
+    assert wide.spec == P(None, "model")
+    assert narrow.spec == P()

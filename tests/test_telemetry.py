@@ -290,6 +290,47 @@ def test_device_stream_holds_one_dispatch_then_drains_to_registry():
     assert steps.value(algo="ppo") == 400.0
 
 
+def test_device_stream_drains_mesh_sharded_metrics():
+    """ShardedRuntime supersteps hand the stream stacked metrics that
+    live ACROSS the mesh (a P('data')-sharded leaf next to replicated
+    scalars).  The drain must device_get the whole tree in one host
+    fetch and land the same registry values as host arrays — no
+    per-step sync, no per-leaf fetch."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from gymfx_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    rep = NamedSharding(mesh, PartitionSpec())
+    shd = NamedSharding(mesh, PartitionSpec(None, "data"))
+    reg = MetricsRegistry()
+    s = DeviceMetricStream("ppo", iters=4, registry=reg, steps_per_iter=100)
+    # (k,) stacked counters replicated over the mesh; one (k, 8) leaf
+    # genuinely sharded over 'data' — as a superstep's stacked
+    # per-shard diagnostics would be
+    s.after_dispatch(0, 2, {
+        "nonfinite_skips": jax.device_put(np.array([1.0, 2.0]), rep),
+        "per_shard_loss": jax.device_put(
+            np.arange(16.0).reshape(2, 8), shd
+        ),
+    })
+    ctr = reg.counter("gymfx_train_nonfinite_skips_total", labels=("algo",))
+    assert ctr.value(algo="ppo") == 0.0  # still one dispatch behind
+    s.after_dispatch(2, 2, {
+        "nonfinite_skips": jax.device_put(np.array([0.0, 1.0]), rep),
+        "per_shard_loss": jax.device_put(np.zeros((2, 8)), shd),
+    })
+    assert ctr.value(algo="ppo") == 3.0
+    s.finish()
+    assert ctr.value(algo="ppo") == 4.0
+    gauge = reg.gauge("gymfx_train_metric", labels=("algo", "metric"))
+    # newest value of the raveled sharded leaf (last element of step 2)
+    assert gauge.value(algo="ppo", metric="per_shard_loss") == 0.0
+
+
 def test_device_stream_sink_row_per_drained_dispatch(tmp_path):
     sink = JsonlSink(str(tmp_path / "train.jsonl"))
     s = DeviceMetricStream("impala", iters=2, sink=sink)

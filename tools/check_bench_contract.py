@@ -64,6 +64,13 @@ def validate_record(record: Dict[str, Any],
             problems.append(
                 f"key {key!r} must be a finite number, got {record[key]!r}"
             )
+    for key in spec.get("integer", ()):
+        if key in record and not (
+            isinstance(record[key], int) and not isinstance(record[key], bool)
+        ):
+            problems.append(
+                f"key {key!r} must be an integer, got {record[key]!r}"
+            )
     for key in spec.get("numeric_or_null", ()):
         if key in record and record[key] is not None \
                 and not _is_finite_number(record[key]):

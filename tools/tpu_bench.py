@@ -104,15 +104,31 @@ def _portfolio_trainer(n_envs: int, horizon: int, window: int = 32, **over):
 
 def _measure(trainer, n_envs: int, horizon: int, iters: int,
              split_rollout: bool = False, profile_dir=None):
-    """(steps/sec, mfu, flops, split) for the fused train step; with
-    ``profile_dir``, also captures one jax.profiler trace of the SAME
-    compiled executable and state (no second compilation)."""
+    """(steps/sec, mfu, flops, split, analytic_report) for the fused
+    train step; with ``profile_dir``, also captures one jax.profiler
+    trace of the SAME compiled executable and state (no second
+    compilation).  ``analytic_report`` is the telemetry/mfu.py slice
+    (analytic_flops_per_step / hw_flops_peak / mfu_analytic) so the
+    sweep rows carry the closed-form MFU cross-check, not just the
+    XLA cost-model number."""
     import jax
 
     from gymfx_tpu.bench_util import measure_train_step, mfu
 
     state = trainer.init_state(0)
     dt, flops, state, step = measure_train_step(trainer, state, iters)
+
+    from gymfx_tpu.telemetry.mfu import analytic_train_step_flops, mfu_report
+
+    params = (
+        state.params if hasattr(state, "params") else state.learner_params
+    )
+    epochs = int(getattr(getattr(trainer, "pcfg", None), "epochs", 1) or 1)
+    analytic = analytic_train_step_flops(
+        params, num_envs=n_envs, horizon=horizon, update_epochs=epochs,
+    )
+    report = mfu_report(analytic, dt / iters, jax.devices()[0])
+    report.pop("device_memory_bytes", None)  # per-row memory is noise
 
     if profile_dir is not None:
         import jax.profiler
@@ -141,7 +157,7 @@ def _measure(trainer, n_envs: int, horizon: int, iters: int,
 
     steps = n_envs * horizon * iters
     device = jax.devices()[0]
-    return steps / dt, mfu(flops, iters, dt, device), flops, split
+    return steps / dt, mfu(flops, iters, dt, device), flops, split, report
 
 
 def main() -> int:
@@ -155,6 +171,10 @@ def main() -> int:
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="also capture a jax.profiler trace of one "
                          "train step per row into DIR/<policy>_<n_envs>")
+    ap.add_argument("--multichip", action="store_true",
+                    help="also measure the mesh-sharded flagship row "
+                         "over all local devices (aggregate steps/sec + "
+                         "scaling_efficiency; tools/multichip_bench.py)")
     args = ap.parse_args()
 
     import jax
@@ -209,7 +229,7 @@ def main() -> int:
             trainer = _impala_trainer(n_envs, hor, window)
         else:
             trainer = _single_pair_trainer(policy, n_envs, hor, window, **over)
-        sps, util, flops, split_out = _measure(
+        sps, util, flops, split_out, analytic = _measure(
             trainer, n_envs, hor, args.iters, split_rollout=split,
             profile_dir=(
                 Path(args.profile) / f"{policy}_{n_envs}"
@@ -225,6 +245,9 @@ def main() -> int:
             "vs_baseline": round(sps / BASELINE_PER_CHIP, 3),
             "mfu": round(util, 5) if util is not None else None,
             "step_flops_xla": flops,
+            # closed-form cross-check of the cost-model MFU
+            # (gymfx_tpu/telemetry/mfu.py); null off-TPU
+            **analytic,
         }
         if policy == "portfolio_mlp":
             row["n_pairs"] = 3
@@ -361,8 +384,24 @@ def main() -> int:
                           "between regenerations)",
         }
 
+    # mesh-sharded flagship row: the same record the MULTICHIP harness
+    # emits (schema metric multichip_env_steps_per_sec), committed into
+    # the sweep artifact so scaling numbers regenerate with the rest
+    multichip = None
+    if args.multichip and len(jax.devices()) >= 2:
+        sys.path.insert(0, str(Path(__file__).resolve().parent))
+        from multichip_bench import build_record
+
+        multichip = build_record(
+            n_envs=256 if args.quick else 8192,
+            horizon=16 if args.quick else horizon,
+            iters=args.iters, measure_split=not args.quick,
+        )
+        print(json.dumps(multichip), flush=True)
+
     artifact = {
-        "schema": "tpu_bench_sweep.v2",
+        "schema": "tpu_bench_sweep.v3",
+        "multichip": multichip,
         "headline": headline,
         "notes": notes,
         "date_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(
