@@ -21,6 +21,93 @@ from gymfx_tpu.bench_util import ensure_cpu_if_requested
 ensure_cpu_if_requested()
 
 
+def lob_main(args) -> None:
+    """``--lob``: matching-engine fills/sec depth sweep — one
+    schema-valid ``lob_fills_per_sec`` JSON line (the venue's
+    message-processing hot loop, no env/ledger around it).
+
+    Workload: ``books`` independent message streams from the lob_calm
+    flow mix (flow.random_message_streams — the SAME streams the
+    4096-way parity test replays through the Python oracle), each
+    scanned through a fresh fixed-capacity book under ``jit(vmap(...))``,
+    repeated across ``--depths``.  The headline row is the venue's
+    default depth (24 levels); every swept depth lands in
+    ``depth_sweep``.
+    """
+    import time
+
+    from gymfx_tpu.bench_util import probe_device
+
+    probe_device("lob_fills_per_sec", unit="fills/sec/chip")
+
+    import jax
+    import jax.numpy as jnp
+
+    from gymfx_tpu.lob.book import empty_book, process_stream
+    from gymfx_tpu.lob.flow import random_message_streams
+    from gymfx_tpu.lob.scenarios import scenario_flow_params
+
+    books, messages, iters = args.books, args.messages, args.iters
+    depths = [int(d) for d in args.depths.split(",") if d.strip()]
+    if args.quick:
+        books, messages, iters, depths = 256, 64, 2, [8, 24]
+    queue_slots = 4  # the venue default (config/defaults.py)
+    fp = scenario_flow_params("lob_calm")
+    key = jax.random.PRNGKey(0)
+
+    sweep = {}
+    for depth in depths:
+        msgs = jax.block_until_ready(
+            random_message_streams(key, books, messages, fp)
+        )
+
+        @jax.jit
+        def run(ms, depth=depth):
+            return jax.vmap(
+                lambda m: process_stream(empty_book(depth, queue_slots), m)
+            )(ms)
+
+        book, fills = run(msgs)  # compile + warmup
+        jax.block_until_ready(book)
+        events = int(jnp.sum(fills.fill_events))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            book, fills = run(msgs)
+        jax.block_until_ready(book)
+        dt = time.perf_counter() - t0
+        per_dispatch = dt / iters
+        sweep[str(depth)] = {
+            "fills_per_sec": round(events / per_dispatch, 1),
+            "msgs_per_sec": round(books * messages / per_dispatch, 1),
+            "match_ms": round(per_dispatch * 1e3, 3),
+            "fill_events_per_dispatch": events,
+        }
+
+    headline_depth = 24 if "24" in sweep else depths[0]
+    head = sweep[str(headline_depth)]
+    print(
+        json.dumps(
+            {
+                "metric": "lob_fills_per_sec",
+                "value": head["fills_per_sec"],
+                "unit": (
+                    "fills/sec/chip (vmapped LOB matching, "
+                    f"depth={headline_depth}x{queue_slots} slots, "
+                    "lob_calm flow mix)"
+                ),
+                "fills_per_sec_per_chip": head["fills_per_sec"],
+                "msgs_per_sec": head["msgs_per_sec"],
+                "match_ms": head["match_ms"],
+                "books": books,
+                "depth_levels": headline_depth,
+                "queue_slots": queue_slots,
+                "messages_per_stream": messages,
+                "depth_sweep": sweep,
+            }
+        )
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n_envs", type=int, default=8192)
@@ -39,7 +126,21 @@ def main() -> None:
         help="capture a jax.profiler trace of the timed loop into DIR "
              "(view with tensorboard or xprof)",
     )
+    # LOB matching-engine sweep (docs/lob.md)
+    ap.add_argument(
+        "--lob", action="store_true",
+        help="benchmark the LOB matching engine instead of PPO "
+             "(emits a lob_fills_per_sec record)",
+    )
+    ap.add_argument("--books", type=int, default=1024)
+    ap.add_argument("--messages", type=int, default=256)
+    ap.add_argument(
+        "--depths", type=str, default="8,16,24,48",
+        help="comma-separated book depths for the --lob sweep",
+    )
     args = ap.parse_args()
+    if args.lob:
+        return lob_main(args)
     if args.quick:
         args.n_envs, args.horizon, args.iters = 256, 32, 2
 

@@ -35,6 +35,21 @@ def parse_args(argv=None):
     parser.add_argument("--reward_plugin", type=str)
     parser.add_argument("--metrics_plugin", type=str)
 
+    # execution venue (docs/lob.md)
+    parser.add_argument("--venue", choices=["bar", "lob"])
+    parser.add_argument("--lob_depth_levels", type=int)
+    parser.add_argument("--lob_queue_slots", type=int)
+    parser.add_argument("--lob_messages_per_bar", type=int)
+    parser.add_argument("--lob_seed_levels", type=int)
+    parser.add_argument("--lob_flow_seed", type=int)
+    parser.add_argument(
+        "--lob_scenario",
+        choices=["lob_calm", "lob_trend", "lob_volatile", "lob_thin",
+                 "lob_flash_crash"],
+    )
+    parser.add_argument("--lob_tick_size", type=float)
+    parser.add_argument("--lob_lot_units", type=float)
+
     parser.add_argument("--replay_actions_file", type=str)
     parser.add_argument("--results_file", type=str)
     parser.add_argument("--load_config", type=str)

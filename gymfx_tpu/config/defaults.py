@@ -43,6 +43,19 @@ DEFAULT_VALUES = {
     # instrument's tick grid, order sizes on its size step, min_quantity
     # denial — the replay venue's book semantics (DIVERGENCES #9d closed)
     "venue_quantization": False,
+    # execution venue: "bar" = broker scan (next-open fills, H/L
+    # brackets); "lob" = the vectorized limit-order-book engine
+    # (gymfx_tpu/lob/, docs/lob.md) — agent orders walk a seeded book
+    # driven by a deterministic per-bar message flow
+    "venue": "bar",
+    "lob_depth_levels": 24,      # book price levels per side
+    "lob_queue_slots": 4,        # FIFO orders per level
+    "lob_messages_per_bar": 64,  # flow messages per bar (static shape)
+    "lob_seed_levels": 8,        # seeded depth levels per side at open
+    "lob_flow_seed": 0,          # order-flow PRNG seed
+    "lob_scenario": "lob_calm",  # lob_calm|lob_trend|lob_volatile|lob_thin|lob_flash_crash
+    "lob_tick_size": 1e-5,       # quote-currency size of one book tick
+    "lob_lot_units": 0.0,        # units per lot (0 = position_size)
     "action_space_mode": "discrete",  # discrete|continuous
     "continuous_action_threshold": 0.33,
     "seed": 0,

@@ -146,12 +146,23 @@ def step(
 
     st = state._replace(t=t_new, last_trade_cost=jnp.zeros_like(state.last_trade_cost))
 
-    # 1. pending order fills at the new bar's open (only when advancing)
-    st_f = broker.fill_pending(st, o, params, cfg, h, l)
-    st = _select(advance, st_f, st)
-    # 2. brackets resolve against the new bar's H/L
-    st_b = broker.check_brackets(st, o, h, l, cfg, params)
-    st = _select(advance, st_b, st)
+    if cfg.venue == "lob":
+        # 1+2 (LOB venue): the pending order walks the seeded book at
+        # the open and brackets resolve against actual prints along the
+        # bar's message flow (gymfx_tpu/lob/venue.py).  Static branch:
+        # with venue unset the bar path below is traced bit-identically
+        # and no LOB code reaches the hot path.
+        from gymfx_tpu.lob import venue as lob_venue
+
+        st_l = lob_venue.execute_bar(st, o, h, l, c, t_new, cfg, params)
+        st = _select(advance, st_l, st)
+    else:
+        # 1. pending order fills at the new bar's open (only when advancing)
+        st_f = broker.fill_pending(st, o, params, cfg, h, l)
+        st = _select(advance, st_f, st)
+        # 2. brackets resolve against the new bar's H/L
+        st_b = broker.check_brackets(st, o, h, l, cfg, params)
+        st = _select(advance, st_b, st)
     # 2b. FX rollover financing: the position held at a rollover bar
     #     (first bar at/after 22:00 UTC of its day) accrues interest from
     #     the pair's daily rate differential, precomputed into

@@ -124,6 +124,10 @@ class Environment:
         # degraded (reference wires these through Nautilus' LatencyModel /
         # FXRolloverInterestModule, simulation_engines/nautilus_gym.py:276-310).
         validate_profile_latency(profile, self.dataset.bar_interval_ms())
+        if self.cfg.venue == "lob":
+            from gymfx_tpu.lob.venue import validate_lob_venue
+
+            validate_lob_venue(self.cfg, self.config)
         financing_rate_data = load_financing_rates(
             self.config, self.cfg.financing_enabled
         )

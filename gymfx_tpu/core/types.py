@@ -107,6 +107,21 @@ class EnvConfig:
     sharpe_window: int = 64
     stage_b_force_close_reward_penalty: bool = False
 
+    # execution venue: "bar" = the broker scan (fill at next open,
+    # brackets vs H/L); "lob" = the vectorized limit-order-book engine
+    # (gymfx_tpu/lob/): agent orders walk a seeded book driven by a
+    # deterministic per-bar message flow.  Static so the bar path stays
+    # bitwise identical when unset — the LOB branch is never traced.
+    venue: str = "bar"                       # bar | lob
+    lob_depth_levels: int = 24               # price levels per side
+    lob_queue_slots: int = 4                 # FIFO orders per level
+    lob_messages_per_bar: int = 64           # flow messages per bar (static)
+    lob_seed_levels: int = 8                 # seeded levels per side at open
+    lob_flow_seed: int = 0                   # order-flow PRNG seed
+    lob_scenario: str = "lob_calm"           # lob/scenarios.py preset
+    lob_tick_size: float = 1e-5              # quote-currency size of one tick
+    lob_lot_units: float = 0.0               # units per lot (0 = position_size)
+
     intrabar_collision_policy: str = "worst_case"  # worst_case | adaptive | ohlc
     # "cross" (price-improving gap fills) is the scan engine's historical
     # no-profile behavior; profiles always set the field explicitly.
@@ -163,6 +178,26 @@ class EnvConfig:
             raise ValueError(
                 f"unknown limit_fill_policy {self.limit_fill_policy!r}"
             )
+        if self.venue not in ("bar", "lob"):
+            raise ValueError(f"venue must be bar|lob, got {self.venue!r}")
+        if self.venue == "lob":
+            if self.lob_depth_levels < 2:
+                raise ValueError("lob_depth_levels must be >= 2")
+            if self.lob_queue_slots < 1:
+                raise ValueError("lob_queue_slots must be >= 1")
+            if self.lob_messages_per_bar < 1:
+                raise ValueError("lob_messages_per_bar must be >= 1")
+            if not 0 <= self.lob_seed_levels <= self.lob_depth_levels:
+                raise ValueError(
+                    "lob_seed_levels must be in [0, lob_depth_levels]"
+                )
+            if self.lob_tick_size <= 0:
+                raise ValueError("lob_tick_size must be > 0")
+            if self.lob_lot_units < 0:
+                raise ValueError("lob_lot_units must be >= 0")
+            from gymfx_tpu.lob.scenarios import scenario_flow_params
+
+            scenario_flow_params(self.lob_scenario)  # honor-or-reject
 
 
 class EnvParams(NamedTuple):
@@ -406,6 +441,15 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         stage_b_force_close_reward_penalty=bool(
             config.get("stage_b_force_close_reward_penalty", False)
         ),
+        venue=str(config.get("venue", "bar")).lower(),
+        lob_depth_levels=int(config.get("lob_depth_levels", 24)),
+        lob_queue_slots=int(config.get("lob_queue_slots", 4)),
+        lob_messages_per_bar=int(config.get("lob_messages_per_bar", 64)),
+        lob_seed_levels=int(config.get("lob_seed_levels", 8)),
+        lob_flow_seed=int(config.get("lob_flow_seed", 0)),
+        lob_scenario=str(config.get("lob_scenario", "lob_calm")),
+        lob_tick_size=float(config.get("lob_tick_size", 1e-5)),
+        lob_lot_units=float(config.get("lob_lot_units", 0.0)),
         intrabar_collision_policy=collision,
         limit_fill_policy=limit_fill,
         slip_open=bool(config.get("slip_open", True)),

@@ -1,0 +1,20 @@
+"""Vectorized limit-order-book venue (pure JAX) + Python oracle twin.
+
+  book.py       branch-free matching engine (jit/vmap/scan-composable)
+  oracle.py     exact pure-Python reference book (parity contract)
+  flow.py       seeded bar -> message-stream order-flow process
+  scenarios.py  named flow presets (the lob_* training scenario family)
+  venue.py      per-bar agent execution wired into core/env.py
+"""
+from .book import (  # noqa: F401
+    AGENT_OID,
+    PRICE_CAP,
+    BookState,
+    FillRecord,
+    Messages,
+    empty_book,
+    process_message,
+    process_stream,
+)
+from .flow import FlowParams, bar_key, bar_messages, seed_messages  # noqa: F401
+from .scenarios import scenario_flow_params, scenario_names  # noqa: F401

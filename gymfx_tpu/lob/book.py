@@ -1,0 +1,323 @@
+"""Branch-free limit-order-book matching engine (JAX-LOB style).
+
+A fixed-capacity book as pure array state: ``depth_levels`` price
+levels per side, each holding a ``queue_slots``-deep FIFO of resting
+orders, composable under ``jit``/``vmap``/``lax.scan`` exactly like the
+bar broker kernel (core/broker.py) — no Python branching on data, so
+thousands of books match in one vmapped program (PAPERS.md: JAX-LOB
+arXiv:2308.13289, JaxMARL-HFT arXiv:2511.02136).
+
+All quantities are integer lots and all prices are integer ticks
+(int32): matching is EXACT, and the pure-Python oracle
+(``lob/oracle.py``) reproduces every fill bit-for-bit — the parity
+contract behind the LOB crosscheck (simulation/crosscheck.py).
+
+Semantics (price-time priority):
+  * a level is *active* while it holds quantity; its price lives in the
+    per-level ``*_price`` array (0 = unused).  A resting order at a new
+    price claims the LOWEST-index free level; when no level is free the
+    order is dropped (``rested_qty`` 0) — fixed capacity is venue
+    behavior, not an error;
+  * within a level, orders queue FIFO in slot order; a full queue drops
+    the incoming order; matched-out slots compact toward the front so
+    slot 0 is always the queue head;
+  * market orders walk eligible levels best-price-first and fill
+    partially when liquidity runs out; limit adds match their
+    marketable part first (price improvement at maker prices — the
+    book-native form of the bar engine's ``cross`` gap fills) and rest
+    the remainder;
+  * cancels remove every live slot owned by ``oid`` on the given side
+    (flow oids are unique per message, so this is one order).
+
+Prices must stay below ``PRICE_CAP`` (2**20 ticks ≈ 10.5 for a 1e-5
+tick) so the flattened price-time sort key stays exact in int32.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# tick-price ceiling: the price-time sort key is price * queue_slots +
+# slot, kept exact in int32 (2**20 * 64 slots << 2**31)
+PRICE_CAP = 1 << 20
+# reserved owner id for the trading agent's resting orders (flow
+# messages use 1..M, seed messages SEED_OID_BASE+; 0 = empty slot)
+AGENT_OID = 1 << 29
+SEED_OID_BASE = 1 << 24
+
+# message kinds
+MSG_NOOP = 0
+MSG_ADD = 1     # limit order: match marketable part, rest the remainder
+MSG_CANCEL = 2  # cancel by (side, oid)
+MSG_MARKET = 3  # market order: walk the book, partial-fill on dry-up
+
+
+class BookState(NamedTuple):
+    """Fixed-capacity two-sided book (all int32, all static shapes)."""
+
+    bid_price: Any  # (D,)  tick price per level, 0 = unused
+    bid_qty: Any    # (D, Q) FIFO slot quantities in lots, 0 = empty
+    bid_oid: Any    # (D, Q) owner ids, 0 = empty
+    ask_price: Any  # (D,)
+    ask_qty: Any    # (D, Q)
+    ask_oid: Any    # (D, Q)
+
+    @property
+    def depth_levels(self) -> int:
+        return int(self.bid_qty.shape[0])
+
+    @property
+    def queue_slots(self) -> int:
+        return int(self.bid_qty.shape[1])
+
+
+class Messages(NamedTuple):
+    """A stream of M book messages (arrays of shape (M,), int32)."""
+
+    kind: Any   # MSG_*
+    side: Any   # +1 buy / -1 sell
+    price: Any  # ticks (ADD: limit price; MARKET: ignored)
+    qty: Any    # lots
+    oid: Any    # order id (ADD: the resting id; CANCEL: the target)
+
+
+class FillRecord(NamedTuple):
+    """Execution report for one processed message (int32 scalars)."""
+
+    filled_qty: Any    # lots matched by this message (taker side)
+    filled_value: Any  # sum(maker price * lots) in tick-lots
+    fill_events: Any   # number of maker slots touched
+    agent_qty: Any     # lots filled against AGENT_OID resting orders
+    agent_value: Any   # sum(price * lots) of those agent maker fills
+    price_min: Any     # lowest traded price (PRICE_CAP when no fill)
+    price_max: Any     # highest traded price (0 when no fill)
+    rested_qty: Any    # lots rested by an ADD (0 when dropped/matched)
+    cancelled_qty: Any # lots removed by a CANCEL
+
+
+def _zero_fill() -> FillRecord:
+    z = jnp.int32(0)
+    return FillRecord(z, z, z, z, z, jnp.int32(PRICE_CAP), z, z, z)
+
+
+def empty_book(depth_levels: int, queue_slots: int) -> BookState:
+    lvl = jnp.zeros((depth_levels,), jnp.int32)
+    slots = jnp.zeros((depth_levels, queue_slots), jnp.int32)
+    return BookState(lvl, slots, slots, lvl, slots, slots)
+
+
+def best_bid(book: BookState):
+    """Highest active bid price (0 when the side is empty)."""
+    active = book.bid_qty.sum(axis=1) > 0
+    return jnp.max(jnp.where(active, book.bid_price, 0))
+
+
+def best_ask(book: BookState):
+    """Lowest active ask price (PRICE_CAP when the side is empty)."""
+    active = book.ask_qty.sum(axis=1) > 0
+    return jnp.min(jnp.where(active, book.ask_price, PRICE_CAP))
+
+
+def side_depth(book: BookState, is_bid: bool):
+    """Total resting lots on one side."""
+    return (book.bid_qty if is_bid else book.ask_qty).sum()
+
+
+# ---------------------------------------------------------------------------
+# half-book primitives (price, qty, oid) — shared by both sides
+# ---------------------------------------------------------------------------
+def _compact(qty, oid):
+    """Shift live slots to the queue front, preserving FIFO order."""
+    order = jnp.argsort(qty == 0, axis=1, stable=True)
+    return (
+        jnp.take_along_axis(qty, order, axis=1),
+        jnp.take_along_axis(oid, order, axis=1),
+    )
+
+
+def _reset_empty_levels(price, qty):
+    return jnp.where(qty.sum(axis=1) > 0, price, 0)
+
+
+def _match_half(price, qty, oid, take_qty, limit, against_asks: bool):
+    """Match ``take_qty`` lots against one half book in price-time
+    priority; returns the updated half plus the taker's fill stats.
+
+    ``against_asks``: the taker BUYS, eligible levels have
+    price <= limit, walked ascending.  Otherwise the taker SELLS,
+    eligible levels have price >= limit, walked descending.
+    """
+    D, Q = qty.shape
+    active = price > 0
+    if against_asks:
+        eligible = active & (price <= limit)
+        level_key = jnp.where(eligible, price, PRICE_CAP)
+    else:
+        eligible = active & (price >= limit)
+        level_key = jnp.where(eligible, PRICE_CAP - price, PRICE_CAP)
+    # price-time priority: unique flattened key = level price rank then
+    # FIFO slot index (levels never share a price, so keys are unique)
+    flat_key = (level_key[:, None] * Q + jnp.arange(Q, dtype=jnp.int32)).reshape(-1)
+    order = jnp.argsort(flat_key)
+    avail = jnp.where(eligible[:, None], qty, 0).reshape(-1)[order]
+    cum = jnp.cumsum(avail)
+    fill_sorted = jnp.clip(take_qty - (cum - avail), 0, avail)
+    fill = jnp.zeros((D * Q,), jnp.int32).at[order].set(fill_sorted)
+    fill = fill.reshape(D, Q)
+
+    # sums pinned to int32: under jax_enable_x64 integer reductions
+    # promote to int64, which would split lax.switch branch signatures
+    filled = fill.sum(dtype=jnp.int32)
+    value = (fill * price[:, None]).sum(dtype=jnp.int32)
+    events = (fill > 0).sum(dtype=jnp.int32)
+    agent = (oid == AGENT_OID) & (fill > 0)
+    agent_qty = jnp.where(agent, fill, 0).sum(dtype=jnp.int32)
+    agent_value = (jnp.where(agent, fill, 0) * price[:, None]).sum(dtype=jnp.int32)
+    touched = fill.sum(axis=1) > 0
+    pmin = jnp.min(jnp.where(touched, price, PRICE_CAP))
+    pmax = jnp.max(jnp.where(touched, price, 0))
+
+    new_qty = qty - fill
+    new_oid = jnp.where(new_qty > 0, oid, 0)
+    new_qty, new_oid = _compact(new_qty, new_oid)
+    new_price = _reset_empty_levels(price, new_qty)
+    stats = (filled, value, events, agent_qty, agent_value, pmin, pmax)
+    return (new_price, new_qty, new_oid), stats
+
+
+def _rest_half(price, qty, oid, p, q, o):
+    """Rest ``q`` lots owned by ``o`` at price ``p`` on one half book.
+    Returns the updated half and the lots actually rested (0 when the
+    book/level is full — fixed capacity drops the order)."""
+    has_level = (price == p) & (price > 0)
+    level_free = qty.sum(axis=1) == 0
+    li = jnp.where(
+        has_level.any(), jnp.argmax(has_level), jnp.argmax(level_free)
+    )
+    can = (q > 0) & (has_level.any() | level_free.any())
+    slot_free = qty[li] == 0
+    si = jnp.argmax(slot_free)
+    can = can & slot_free.any()
+    rested = jnp.where(can, q, 0)
+    qty = qty.at[li, si].set(jnp.where(can, q, qty[li, si]))
+    oid = oid.at[li, si].set(jnp.where(can, o, oid[li, si]))
+    price = price.at[li].set(jnp.where(can, p, price[li]))
+    return (price, qty, oid), rested
+
+
+def _cancel_half(price, qty, oid, target_oid):
+    """Remove every live slot owned by ``target_oid``."""
+    hit = (oid == target_oid) & (qty > 0) & (target_oid != 0)
+    removed = jnp.where(hit, qty, 0).sum(dtype=jnp.int32)
+    qty = jnp.where(hit, 0, qty)
+    oid = jnp.where(hit, 0, oid)
+    qty, oid = _compact(qty, oid)
+    price = _reset_empty_levels(price, qty)
+    return (price, qty, oid), removed
+
+
+# ---------------------------------------------------------------------------
+# book-level operations
+# ---------------------------------------------------------------------------
+def _bids(book: BookState):
+    return book.bid_price, book.bid_qty, book.bid_oid
+
+
+def _asks(book: BookState):
+    return book.ask_price, book.ask_qty, book.ask_oid
+
+
+def _with_bids(book: BookState, half) -> BookState:
+    return book._replace(bid_price=half[0], bid_qty=half[1], bid_oid=half[2])
+
+
+def _with_asks(book: BookState, half) -> BookState:
+    return book._replace(ask_price=half[0], ask_qty=half[1], ask_oid=half[2])
+
+
+def match_market(book: BookState, is_buy, qty) -> Tuple[BookState, FillRecord]:
+    """Execute a market order of ``qty`` lots; partial when the
+    opposing side runs dry.  ``is_buy`` may be traced (bool)."""
+
+    def buy(b):
+        half, s = _match_half(*_asks(b), qty, PRICE_CAP, True)
+        return _with_asks(b, half), s
+
+    def sell(b):
+        half, s = _match_half(*_bids(b), qty, 0, False)
+        return _with_bids(b, half), s
+
+    new_book, s = jax.lax.cond(is_buy, buy, sell, book)
+    z = jnp.int32(0)
+    return new_book, FillRecord(s[0], s[1], s[2], s[3], s[4], s[5], s[6], z, z)
+
+
+def add_limit(book: BookState, is_buy, price, qty, oid) -> Tuple[BookState, FillRecord]:
+    """Limit order: match the marketable part at maker prices, rest the
+    remainder at ``price`` (dropped when the book is full)."""
+
+    def buy(b):
+        half, s = _match_half(*_asks(b), qty, price, True)
+        b = _with_asks(b, half)
+        rest_half, rested = _rest_half(*_bids(b), price, qty - s[0], oid)
+        return _with_bids(b, rest_half), s, rested
+
+    def sell(b):
+        half, s = _match_half(*_bids(b), qty, price, False)
+        b = _with_bids(b, half)
+        rest_half, rested = _rest_half(*_asks(b), price, qty - s[0], oid)
+        return _with_asks(b, rest_half), s, rested
+
+    new_book, s, rested = jax.lax.cond(is_buy, buy, sell, book)
+    return new_book, FillRecord(
+        s[0], s[1], s[2], s[3], s[4], s[5], s[6], rested, jnp.int32(0)
+    )
+
+
+def cancel(book: BookState, is_buy, oid) -> Tuple[BookState, FillRecord]:
+    def buy(b):
+        half, removed = _cancel_half(*_bids(b), oid)
+        return _with_bids(b, half), removed
+
+    def sell(b):
+        half, removed = _cancel_half(*_asks(b), oid)
+        return _with_asks(b, half), removed
+
+    new_book, removed = jax.lax.cond(is_buy, buy, sell, book)
+    return new_book, _zero_fill()._replace(cancelled_qty=removed)
+
+
+def process_message(book: BookState, msg) -> Tuple[BookState, FillRecord]:
+    """Dispatch one message (kind, side, price, qty, oid)."""
+    kind, side, price, qty, oid = msg
+    is_buy = side > 0
+
+    def do_noop(b):
+        return b, _zero_fill()
+
+    def do_add(b):
+        return add_limit(b, is_buy, price, qty, oid)
+
+    def do_cancel(b):
+        return cancel(b, is_buy, oid)
+
+    def do_market(b):
+        return match_market(b, is_buy, qty)
+
+    return jax.lax.switch(
+        jnp.clip(kind, 0, 3), (do_noop, do_add, do_cancel, do_market), book
+    )
+
+
+def process_stream(book: BookState, msgs: Messages) -> Tuple[BookState, FillRecord]:
+    """Scan a message stream through the book; returns the final book
+    and the stacked per-message fill records — the shape the parity
+    test and the fills/sec bench both consume."""
+
+    def step(b, m):
+        b, fill = process_message(b, m)
+        return b, fill
+
+    return jax.lax.scan(step, book, tuple(msgs))

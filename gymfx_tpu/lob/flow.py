@@ -1,0 +1,180 @@
+"""Seeded order-flow process: bars -> per-bar LOB message streams.
+
+The LOB venue replays the SAME bar data the bar engine trades
+(data/feed.py ``MarketData``), so the flow process is a deterministic
+bridge: each bar's O/H/L/C (converted to integer ticks) pins a
+piecewise reference path O -> H -> L -> C (or O -> L -> H -> C when the
+bar closes above its open), and a ``jax.random``-seeded message stream
+decorates that path with limit adds, cancels, and market orders whose
+intensities come from :class:`FlowParams`.  Determinism contract:
+
+  * the stream for bar ``t`` depends only on
+    ``fold_in(PRNGKey(lob_flow_seed), t_global)`` and the bar's OHLC —
+    never on episode state — so the crosscheck oracle replay
+    (simulation/crosscheck.py) regenerates bit-identical streams, and
+    streamed shards reproduce full-dataset flow (feed.py row0 rebase
+    keeps ``t_global`` stable);
+  * threefry is backend-stable, so CPU tests pin TPU behavior;
+  * all prices are clipped to ``[1, PRICE_CAP - 1]`` ticks (price 0 is
+    the book's empty-level sentinel) and quantities to
+    ``[1, QTY_CAP]`` lots so int32 value accumulation cannot overflow.
+
+Messages per bar is a STATIC count (``lob_messages_per_bar``): the
+stream shape is fixed, and low-activity scenarios thin the flow by
+turning messages into ``MSG_NOOP`` rather than shortening arrays.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .book import MSG_ADD, MSG_CANCEL, MSG_MARKET, MSG_NOOP, PRICE_CAP, Messages, SEED_OID_BASE
+
+# per-order lot cap: 2**10 lots * PRICE_CAP ticks * queue depth stays
+# far inside int32 for the engine's value accumulators
+QTY_CAP = 1 << 10
+
+
+class FlowParams(NamedTuple):
+    """Numeric knobs of the order-flow process (a pytree leaf bundle —
+    jit-traceable, so scenarios can be swept under vmap)."""
+
+    p_add: Any = 0.55      # P(message is a limit add)
+    p_cancel: Any = 0.15   # P(message is a cancel); rest are markets
+    p_noop: Any = 0.0      # P(message is a no-op) — thins activity
+    base_qty: Any = 8      # mean order size in lots
+    qty_jitter: Any = 6    # uniform size jitter [0, qty_jitter]
+    band_ticks: Any = 6    # adds rest within this band off the path
+    market_qty: Any = 4    # mean market-order size in lots
+    seed_qty: Any = 16     # lots per seeded level at bar open
+    crash_at: Any = -1     # message index where a sell burst starts (<0: off)
+    crash_len: Any = 0     # burst length in messages
+    crash_qty: Any = 32    # lots per burst market sell
+
+
+def price_to_ticks(price, tick):
+    """Float price -> int32 tick grid (round-half-away keeps the map
+    monotone in f32; exactness is not required here because ticks ARE
+    the venue's price system from this point on)."""
+    return jnp.clip(
+        jnp.round(price / tick).astype(jnp.int32), 1, PRICE_CAP - 1
+    )
+
+
+def reference_path(o, h, l, c, n_msgs: int):
+    """Deterministic intrabar tick path visiting O, H, L, C.
+
+    Bull bars (c >= o) sweep O -> L -> H -> C, bear bars O -> H -> L -> C
+    — the same worst-case-first ordering assumption the bar broker's
+    bracket resolution documents (core/broker.py:check_brackets).
+    """
+    t = jnp.linspace(0.0, 3.0, n_msgs)
+    bull = c >= o
+    w0 = jnp.where(bull, l, h).astype(jnp.float32)
+    w1 = jnp.where(bull, h, l).astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    cf = c.astype(jnp.float32)
+    seg0 = of + (w0 - of) * jnp.clip(t, 0.0, 1.0)
+    seg1 = w0 + (w1 - w0) * jnp.clip(t - 1.0, 0.0, 1.0)
+    seg2 = w1 + (cf - w1) * jnp.clip(t - 2.0, 0.0, 1.0)
+    path = jnp.where(t <= 1.0, seg0, jnp.where(t <= 2.0, seg1, seg2))
+    return jnp.clip(jnp.round(path).astype(jnp.int32), 1, PRICE_CAP - 1)
+
+
+def seed_messages(o_tick, n_levels: int, fp: FlowParams) -> Messages:
+    """Deterministic book seed at bar open: ``n_levels`` bid levels at
+    ``o - 1 - i`` and ask levels at ``o + 1 + i`` ticks, ``seed_qty``
+    lots each — the baseline depth agent orders walk."""
+    i = jnp.arange(n_levels, dtype=jnp.int32)
+    off = 1 + i
+    kind = jnp.full((2 * n_levels,), MSG_ADD, jnp.int32)
+    side = jnp.concatenate([jnp.ones_like(i), -jnp.ones_like(i)])
+    price = jnp.concatenate([o_tick - off, o_tick + off])
+    price = jnp.clip(price, 1, PRICE_CAP - 1)
+    qty = jnp.full((2 * n_levels,), jnp.int32(fp.seed_qty))
+    qty = jnp.clip(qty, 1, QTY_CAP)
+    oid = SEED_OID_BASE + jnp.arange(2 * n_levels, dtype=jnp.int32)
+    return Messages(kind, side, price, qty, oid)
+
+
+def bar_messages(key, o_tick, h_tick, l_tick, c_tick, n_msgs: int,
+                 fp: FlowParams) -> Messages:
+    """One bar's seeded message stream (static length ``n_msgs``).
+
+    Flow oids are ``1 + message_index`` — unique within the bar and
+    disjoint from ``SEED_OID_BASE`` / ``AGENT_OID`` — and cancels target
+    a uniformly drawn earlier oid (a dead oid cancels nothing, matching
+    real-feed races).
+    """
+    k_kind, k_side, k_jit, k_qty, k_band, k_cxl = jax.random.split(key, 6)
+    idx = jnp.arange(n_msgs, dtype=jnp.int32)
+
+    path = reference_path(o_tick, h_tick, l_tick, c_tick, n_msgs)
+    jitter = jax.random.randint(k_jit, (n_msgs,), -2, 3, dtype=jnp.int32)
+    mid = jnp.clip(path + jitter, l_tick, h_tick)
+    mid = jnp.clip(mid, 1, PRICE_CAP - 1)
+
+    u = jax.random.uniform(k_kind, (n_msgs,))
+    kind = jnp.where(
+        u < fp.p_noop, MSG_NOOP,
+        jnp.where(
+            u < fp.p_noop + fp.p_add, MSG_ADD,
+            jnp.where(u < fp.p_noop + fp.p_add + fp.p_cancel,
+                      MSG_CANCEL, MSG_MARKET),
+        ),
+    ).astype(jnp.int32)
+    side = jnp.where(
+        jax.random.uniform(k_side, (n_msgs,)) < 0.5, 1, -1
+    ).astype(jnp.int32)
+
+    band = 1 + jax.random.randint(
+        k_band, (n_msgs,), 0, jnp.maximum(fp.band_ticks, 1), dtype=jnp.int32
+    )
+    add_price = jnp.clip(mid - side * band, 1, PRICE_CAP - 1)
+
+    qty = jnp.int32(fp.base_qty) + jax.random.randint(
+        k_qty, (n_msgs,), 0, jnp.maximum(fp.qty_jitter, 1), dtype=jnp.int32
+    )
+    mkt_qty = jnp.int32(fp.market_qty) + jax.random.randint(
+        k_qty, (n_msgs,), 0, jnp.maximum(fp.qty_jitter, 1), dtype=jnp.int32
+    )
+    qty = jnp.where(kind == MSG_MARKET, mkt_qty, qty)
+
+    oid = 1 + idx
+    cxl_target = 1 + jnp.floor(
+        jax.random.uniform(k_cxl, (n_msgs,)) * jnp.maximum(idx, 1)
+    ).astype(jnp.int32)
+    oid = jnp.where(kind == MSG_CANCEL, jnp.minimum(cxl_target, idx), oid)
+
+    # flash-crash burst: a contiguous window of forced market sells
+    in_crash = (fp.crash_at >= 0) & (idx >= fp.crash_at) \
+        & (idx < fp.crash_at + fp.crash_len)
+    kind = jnp.where(in_crash, MSG_MARKET, kind)
+    side = jnp.where(in_crash, -1, side)
+    qty = jnp.where(in_crash, jnp.int32(fp.crash_qty), qty)
+
+    qty = jnp.clip(qty, 1, QTY_CAP)
+    price = jnp.where(kind == MSG_ADD, add_price, mid)
+    return Messages(kind, side, price, qty, oid)
+
+
+def bar_key(flow_seed, t_global):
+    """The per-bar stream key — the ONLY randomness the venue uses, so
+    oracle replay and streamed shards regenerate identical flow."""
+    return jax.random.fold_in(
+        jax.random.PRNGKey(jnp.uint32(flow_seed)), jnp.uint32(t_global)
+    )
+
+
+def random_message_streams(key, n_streams: int, n_msgs: int,
+                           fp: FlowParams, o_tick: int = 100):
+    """Batch of seeded streams around a flat reference price — shared
+    by the 4096-stream parity test and the fills/sec bench so both
+    exercise the same message mix."""
+    keys = jax.random.split(key, n_streams)
+    ot = jnp.int32(o_tick)
+    span = jnp.int32(max(4, n_msgs // 8))
+    make = lambda k: bar_messages(k, ot, ot + span, ot - span, ot, n_msgs, fp)
+    return jax.vmap(make)(keys)

@@ -1,0 +1,59 @@
+"""LOB training scenario family: named FlowParams presets.
+
+A scenario is a named microstructure regime — the LOB-venue analogue of
+the bar engine's event overlays (simulation/events.py).  Selecting one
+(`lob_scenario` config key / ``--lob_scenario`` CLI flag) changes ONLY
+the order-flow process; the replayed bar data, the matching engine, and
+the agent's action space are unchanged, so PPO/IMPALA runs across
+scenarios are directly comparable.  All presets keep the flow's
+determinism contract (flow.py): same seed + same bars => same streams.
+
+Presets:
+  * ``lob_calm``        — balanced flow, deep book, mild sizes (default)
+  * ``lob_trend``       — add-heavy, tight bands: persistent one-sided
+                          pressure along the bar path
+  * ``lob_volatile``    — market-order-heavy, larger sizes, wide bands
+  * ``lob_thin``        — sparse flow (high noop rate), shallow seeded
+                          depth: agent orders walk multiple levels
+  * ``lob_flash_crash`` — calm flow with a mid-bar burst of forced
+                          market sells (crash window), stressing
+                          stop-loss prints and partial exits
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .flow import FlowParams
+
+_SCENARIOS: Dict[str, FlowParams] = {
+    "lob_calm": FlowParams(),
+    "lob_trend": FlowParams(
+        p_add=0.70, p_cancel=0.10, band_ticks=3, base_qty=10,
+    ),
+    "lob_volatile": FlowParams(
+        p_add=0.35, p_cancel=0.15, band_ticks=10,
+        base_qty=10, qty_jitter=10, market_qty=8,
+    ),
+    "lob_thin": FlowParams(
+        p_add=0.30, p_cancel=0.10, p_noop=0.35,
+        base_qty=3, qty_jitter=3, market_qty=2, seed_qty=4,
+    ),
+    "lob_flash_crash": FlowParams(
+        crash_at=24, crash_len=8, crash_qty=48,
+    ),
+}
+
+
+def scenario_names() -> Tuple[str, ...]:
+    return tuple(sorted(_SCENARIOS))
+
+
+def scenario_flow_params(name: str) -> FlowParams:
+    """Resolve a scenario name (honor-or-reject: unknown names raise at
+    config-binding time, never mid-training)."""
+    try:
+        return _SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown lob_scenario {name!r}; known: {scenario_names()}"
+        ) from None

@@ -153,6 +153,12 @@ def crosscheck_episode(
     config = dict(config)
     if env is None:
         env = Environment(config)
+    if env.cfg.venue == "lob":
+        raise ValueError(
+            "venue=lob episodes execute through the book engine; "
+            "reconcile them with crosscheck_lob_episode (the LOB's "
+            "pure-Python oracle replay), not the bar-vs-replay crosscheck"
+        )
     if env.cfg.financing_enabled:
         raise ValueError(
             "crosscheck does not model financing; disable financing_enabled "
@@ -369,4 +375,155 @@ def crosscheck_episode(
         "replay_result_hash": result["result_hash"],
         "profile_id": profile.profile_id,
         "latency_ms": profile.latency_ms,
+    }
+
+
+def crosscheck_lob_episode(
+    config: Dict[str, Any],
+    actions: Optional[Sequence[int]] = None,
+    *,
+    steps: Optional[int] = None,
+    seed: int = 0,
+    env: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """Third-engine crosscheck: one ``venue=lob`` scan episode vs the
+    pure-Python reference book oracle (``lob/oracle.OracleVenue``).
+
+    The scan side runs the vectorized JAX book under the rollout; the
+    oracle side REGENERATES every bar's message stream from the same
+    seeded flow process (determinism contract, lob/flow.py), replays it
+    through the plain-Python book, and re-executes the episode's
+    DECISION STREAM (the recorded pending orders — same stream the
+    bar-vs-replay crosscheck consumes) through a float64 ledger mirror.
+    Matching is integer-exact on both sides, so the reconciliation
+    bound carries only compute-dtype ledger rounding; the venue's
+    min-quantity denial counters must agree EXACTLY.
+    """
+    import jax.numpy as jnp
+
+    from gymfx_tpu.core import broker
+    from gymfx_tpu.core.rollout import replay_driver
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.lob.flow import bar_key, bar_messages, price_to_ticks, seed_messages
+    from gymfx_tpu.lob.oracle import OracleVenue
+    from gymfx_tpu.lob.scenarios import scenario_flow_params
+
+    config = dict(config)
+    if env is None:
+        env = Environment(config)
+    cfg = env.cfg
+    if cfg.venue != "lob":
+        raise ValueError("crosscheck_lob_episode requires venue=lob")
+    if cfg.enforce_margin_closeout:
+        raise ValueError(
+            "crosscheck_lob_episode does not model venue-forced "
+            "liquidations (pending_forced is not in the rollout trace); "
+            "disable enforce_margin_closeout"
+        )
+    if cfg.financing_enabled:
+        raise ValueError(
+            "crosscheck does not model financing; disable financing_enabled"
+        )
+
+    n_bars = env.n_bars
+    if actions is None:
+        driver = env.make_driver()
+        n_steps = min(int(steps or config.get("steps", 500)), n_bars - 2)
+        state, trace = env.rollout(driver, n_steps, seed=seed)
+    else:
+        acts = [int(a) for a in actions][: n_bars - 2]
+        state, trace = env.rollout(
+            replay_driver(np.asarray(acts)), len(acts), seed=seed
+        )
+    state, trace = jax.device_get((state, trace))
+    if bool(np.asarray(trace["done"], bool).any()):
+        raise ValueError(
+            "episode terminated early (bankruptcy); crosscheck needs the "
+            "full decision stream to execute in both engines"
+        )
+
+    pend_active = np.asarray(trace["pending_active"], bool).ravel()
+    pend_target = np.asarray(trace["pending_target"], np.float64).ravel()
+    pend_sl = np.asarray(trace["pending_sl"], np.float64).ravel()
+    pend_tp = np.asarray(trace["pending_tp"], np.float64).ravel()
+    order_denied = np.asarray(trace["order_denied"], np.int64).ravel()
+    n_steps = min(len(pend_active), n_bars)
+
+    scan_balance = float(np.asarray(broker.realized_balance(state, env.params)))
+
+    # regenerate the venue's message streams bar-for-bar (same jax flow
+    # kernels, vmapped over the executed bars, fetched once)
+    tick = cfg.lob_tick_size
+    fp = scenario_flow_params(cfg.lob_scenario)
+    data = env.require_resident_data("crosscheck_lob_episode")
+    bars = jnp.arange(1, n_steps, dtype=jnp.int32)
+    o_t = price_to_ticks(data.open[bars], tick)
+    c_t = price_to_ticks(data.close[bars], tick)
+    h_t = jnp.maximum(price_to_ticks(data.high[bars], tick), jnp.maximum(o_t, c_t))
+    l_t = jnp.minimum(price_to_ticks(data.low[bars], tick), jnp.minimum(o_t, c_t))
+    keys = jax.vmap(lambda b: bar_key(cfg.lob_flow_seed, b))(bars)
+    flow = jax.vmap(
+        lambda k, o, h, l, c: bar_messages(
+            k, o, h, l, c, cfg.lob_messages_per_bar, fp
+        )
+    )(keys, o_t, h_t, l_t, c_t)
+    seeds = jax.vmap(lambda o: seed_messages(o, cfg.lob_seed_levels, fp))(o_t)
+    o_ticks, flow_np, seeds_np, o_price = jax.device_get(
+        (o_t, tuple(flow), tuple(seeds), data.open[bars])
+    )
+
+    lot_units = (
+        cfg.lob_lot_units
+        if cfg.lob_lot_units > 0
+        else float(np.asarray(jax.device_get(env.params.position_size)))
+    )
+    oracle = OracleVenue(
+        depth_levels=cfg.lob_depth_levels,
+        queue_slots=cfg.lob_queue_slots,
+        seed_levels=cfg.lob_seed_levels,
+        tick=tick,
+        lot_units=lot_units,
+        commission=float(np.asarray(jax.device_get(env.params.commission))),
+        initial_cash=float(config.get("initial_cash", 10000.0) or 10000.0),
+    )
+    for i, j in enumerate(range(1, n_steps)):
+        oracle.execute_bar(
+            int(o_ticks[i]),
+            float(o_price[i]),
+            tuple(np.asarray(a[i]) for a in seeds_np),
+            tuple(np.asarray(a[i]) for a in flow_np),
+            (
+                bool(pend_active[j - 1]),
+                float(pend_target[j - 1]),
+                float(pend_sl[j - 1]),
+                float(pend_tp[j - 1]),
+            ),
+        )
+
+    oracle_balance = oracle.balance()
+    scan_denied = int(order_denied[n_steps - 1])
+    # matching is integer-exact on both sides; the bound carries only
+    # the scan ledger's compute-dtype rounding across its fills
+    max_price = float(np.max(np.asarray(jax.device_get(data.close))))
+    dtype_eps = 3.0 * float(jnp.finfo(cfg.dtype).eps) * max_price
+    bound = oracle.fills_units * dtype_eps + 0.01
+    divergence = abs(scan_balance - oracle_balance)
+    return {
+        "schema": "lob_crosscheck.v1",
+        "steps": int(n_steps),
+        "bars_executed": int(n_steps - 1),
+        "scan_realized_balance": scan_balance,
+        "oracle_realized_balance": oracle_balance,
+        "divergence": divergence,
+        "quantization_bound": bound,
+        "within_bound": divergence <= bound,
+        "scan_trades": int(np.asarray(state.trade_count)),
+        "scan_denied": scan_denied,
+        "oracle_denied": int(oracle.denied),
+        "denied_match": scan_denied == int(oracle.denied),
+        "oracle_fill_units": float(oracle.fills_units),
+        "scenario": cfg.lob_scenario,
+        "depth_levels": cfg.lob_depth_levels,
+        "queue_slots": cfg.lob_queue_slots,
+        "messages_per_bar": cfg.lob_messages_per_bar,
     }

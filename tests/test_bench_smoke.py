@@ -81,3 +81,59 @@ def test_multichip_bench_quick_emits_schema_valid_scaling_row():
     # off-TPU the anchor comparison and MFU are null, never fabricated
     assert payload["vs_single_chip_anchor"] is None
     assert payload["mfu_analytic"] is None
+
+
+def test_lob_bench_quick_emits_schema_valid_fills_row():
+    """``bench.py --lob --quick`` (PR 8): the final stdout line is a
+    schema-valid ``lob_fills_per_sec`` record from a real vmapped
+    depth sweep — the row ROADMAP item 3 and docs/lob.md quote."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gymfx_jax_cache")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--lob", "--quick"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    payload = json.loads(lines[-1])
+    problems = validate_record(payload)
+    assert not problems, (problems, payload)
+    assert payload["metric"] == "lob_fills_per_sec"
+    assert payload["value"] > 0
+    assert payload["msgs_per_sec"] > 0
+    assert payload["books"] == 256  # --quick shapes
+    assert payload["queue_slots"] == 4
+    # the sweep holds one row per swept depth, each with real numbers
+    assert set(payload["depth_sweep"]) == {"8", "24"}
+    for row in payload["depth_sweep"].values():
+        assert row["fills_per_sec"] > 0
+        assert row["fill_events_per_dispatch"] > 0
+    # headline row == the venue-default depth-24 sweep entry
+    assert payload["depth_levels"] == 24
+    assert payload["value"] == payload["depth_sweep"]["24"]["fills_per_sec"]
+
+
+@pytest.mark.slow
+def test_lob_bench_full_depth_sweep_at_1024_books():
+    """The acceptance-criteria shape: a >=1024-book vmapped sweep still
+    emits a schema-valid record (slow: ~1 min of CPU matching)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gymfx_jax_cache")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--lob",
+         "--books", "1024", "--messages", "64", "--iters", "2",
+         "--depths", "8,24"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    payload = json.loads(
+        [ln for ln in proc.stdout.strip().splitlines() if ln.strip()][-1]
+    )
+    problems = validate_record(payload)
+    assert not problems, (problems, payload)
+    assert payload["books"] == 1024
+    assert payload["messages_per_stream"] == 64
+    assert payload["value"] > 0
+    assert set(payload["depth_sweep"]) == {"8", "24"}

@@ -394,3 +394,67 @@ def test_cli_verify_execution_unsupported_config_records_skip():
     assert cc["status"] == "skipped"
     assert "financing" in cc["reason"]
     assert "total_return" in summary  # the run itself still completed
+
+
+# ---------------------------------------------------------------------------
+# two-engine semantics pinned BEFORE the LOB third engine (PR 8): a
+# regression in either twin is caught here, independent of the LOB
+# ---------------------------------------------------------------------------
+def test_gap_open_through_bracket_fills_at_open_in_both_engines():
+    """A bar that gaps open beyond the armed SL fills the exit at the
+    OPEN, not the stop price, in BOTH engines (module docstring) — the
+    semantic the LOB venue's gap path mirrors (lob/venue.py gap_sl)."""
+    from tests.helpers import make_df, make_env
+
+    closes = [1.1] * 6 + [1.0] * 6
+    df = make_df(
+        closes,
+        opens=closes,
+        highs=[c + 1e-4 for c in closes],
+        lows=[c - 1e-4 for c in closes],
+    )
+    env = make_env(
+        df, strategy_plugin="direct_fixed_sltp", sl_pips=10.0,
+        tp_pips=500.0, position_size=1000.0,
+    )
+    actions = [1] + [0] * 8
+    result = crosscheck_episode(dict(env.config), actions=actions, env=env)
+    assert result["within_bound"], result
+    assert result["replay_fills"] >= 2  # the entry AND the gap-stop exit
+    # exit priced at the gap OPEN (1.0), not the stop (1.099): ~$100
+    # loss on 1000 units — two orders of magnitude beyond the 10-pip
+    # stop distance, so a fill-at-stop regression trips this hard
+    assert result["scan_realized_balance"] < 9905.0, result
+    assert result["divergence"] <= 0.01, result
+
+
+def test_size_precision_zero_fractional_size_divergence_is_bounded():
+    """DIVERGENCES.md #9d pinned: a fractional position size under the
+    venue's size_precision=0 unit grid diverges (the quantized venue
+    fills whole units, the frictionless scan fills 1000.7) but stays
+    within the documented quantization bound; a size grid fine enough
+    to represent the size collapses the divergence."""
+    coarse = crosscheck_episode(
+        _config(
+            driver_mode="random", steps=300, position_size=1000.7,
+            venue_quantization=True, size_precision=0, min_quantity=1.0,
+        ),
+        seed=3,
+    )
+    assert coarse["replay_fills"] > 50
+    assert coarse["within_bound"], coarse
+    # the unit grid really rounds (1000.7 -> 1001): realized divergence
+    # is nonzero, i.e. this is a BOUNDED divergence, not exactness
+    assert coarse["divergence"] > 0.0
+    fine = crosscheck_episode(
+        _config(
+            driver_mode="random", steps=300, position_size=1000.7,
+            venue_quantization=True, size_precision=1, min_quantity=0.1,
+        ),
+        seed=3,
+    )
+    assert fine["within_bound"], fine
+    # 1000.7 sits ON the 0.1 grid: the size-rounding term vanishes and
+    # the bound (and realized divergence) tighten vs the unit grid
+    assert fine["quantization_bound"] <= coarse["quantization_bound"]
+    assert fine["divergence"] <= max(coarse["divergence"], 0.01)

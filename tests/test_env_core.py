@@ -302,3 +302,82 @@ def test_bad_margin_model_rejected():
     with pytest.raises(ValueError, match="margin_model"):
         make_env(uptrend_df(), enforce_margin_preflight=True,
                  margin_model="leverged")
+
+
+# ---------------------------------------------------------------------------
+# broker.quantize in pure-f32 mode (the TPU path: jax_enable_x64 off)
+# ---------------------------------------------------------------------------
+def _f32_quantize(x, tick):
+    """Run broker.quantize with x64 disabled (TPU semantics) regardless
+    of the suite's x64 default."""
+    from gymfx_tpu.core import broker
+
+    with jax.experimental.disable_x64():
+        return np.asarray(
+            jax.device_get(broker.quantize(jnp_f32(x), jnp_f32(tick)))
+        )
+
+
+def jnp_f32(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x, jnp.float32)
+
+
+def test_quantize_tick_zero_is_identity_in_f32():
+    x = np.float32([1.100013, 0.0, -2.5, 1e-7])
+    np.testing.assert_array_equal(_f32_quantize(x, 0.0), x)
+
+
+def test_quantize_on_grid_values_are_fixpoints_in_f32():
+    """Quantizing an already-quantized value must be a no-op — the
+    apply_fill re-quantization identity snap_in_bar relies on."""
+    tick = 1e-5
+    xs = np.float32(1.1) + np.float32(tick) * np.arange(-50, 50, dtype=np.float32)
+    once = _f32_quantize(xs, tick)
+    twice = _f32_quantize(once, tick)
+    np.testing.assert_array_equal(once, twice)
+
+
+def test_quantize_f32_within_one_tick_of_f64_grid():
+    """The documented pure-f32 contract (core/broker.py quantize): the
+    ratio x/tick keeps ~7 fractional bits at FX magnitudes, so a value
+    near a midpoint may flip to the ADJACENT tick vs the f64
+    round-half-even — but never further than one tick."""
+    rng = np.random.default_rng(11)
+    tick = 1e-5
+    xs = np.float32(1.1 + rng.uniform(-0.05, 0.05, 512))
+    got_idx = np.round(_f32_quantize(xs, tick).astype(np.float64) / tick)
+    ref_idx = np.round(xs.astype(np.float64) / tick)
+    assert np.max(np.abs(got_idx - ref_idx)) <= 1  # at most adjacent
+    # and the bulk of draws (away from midpoints) land on the same tick
+    assert (got_idx == ref_idx).mean() > 0.95
+
+
+def test_quantize_f64_mode_rounds_half_even():
+    """With x64 on (the suite default) the ratio x/tick rounds
+    HALF-EVEN — the replay venue's rounding mode.  tick=0.25 is exact
+    in binary, so the midpoint ratios really are .5 and the tie-break
+    is observable (half-away would give 0.25/0.75 here)."""
+    from gymfx_tpu.core import broker
+
+    tick = 0.25
+    xs = np.float64([0.125, 0.375, 0.625, -0.125])
+    got = np.asarray(jax.device_get(broker.quantize(xs, tick)))
+    np.testing.assert_allclose(got, [0.0, 0.5, 0.5, -0.0], atol=1e-15)
+
+
+def test_quantize_composes_under_jit_and_vmap():
+    from gymfx_tpu.core import broker
+
+    with jax.experimental.disable_x64():
+        xs = jnp_f32([1.100013, 1.100017, 1.099996])
+        direct = jax.device_get(broker.quantize(xs, jnp_f32(1e-5)))
+        jitted = jax.device_get(
+            jax.jit(lambda v: broker.quantize(v, jnp_f32(1e-5)))(xs)
+        )
+        vmapped = jax.device_get(
+            jax.vmap(lambda v: broker.quantize(v, jnp_f32(1e-5)))(xs)
+        )
+    np.testing.assert_array_equal(direct, jitted)
+    np.testing.assert_array_equal(direct, vmapped)

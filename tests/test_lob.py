@@ -1,0 +1,523 @@
+"""LOB venue (gymfx_tpu/lob/): matching parity, venue semantics,
+scenario family, crosscheck third engine, honor-or-reject.
+
+The load-bearing contract is PARITY: the vectorized JAX matching
+engine and the pure-Python oracle book replay identical seeded message
+streams and must agree EXACTLY — integer ticks and lots, every
+per-message fill record and the final book, no epsilon.  Everything
+above the book (venue fills, brackets, the crosscheck ledger) then
+inherits exactness on WHAT traded and only carries compute-dtype
+error on the continuous ledger arithmetic.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.lob.book import (
+    AGENT_OID,
+    PRICE_CAP,
+    add_limit,
+    cancel,
+    empty_book,
+    match_market,
+    process_stream,
+)
+from gymfx_tpu.lob.flow import (
+    FlowParams,
+    bar_key,
+    bar_messages,
+    random_message_streams,
+    seed_messages,
+)
+from gymfx_tpu.lob.oracle import replay_messages
+from gymfx_tpu.lob.scenarios import scenario_flow_params, scenario_names
+from tests.helpers import make_df, make_env
+
+DATA = "examples/data/eurusd_sample.csv"
+DEPTH, QSLOTS = 16, 4
+
+
+def _sample_config(**overrides):
+    config = dict(DEFAULT_VALUES, input_data_file=DATA, venue="lob")
+    config.update(overrides)
+    return config
+
+
+def _canonical(book_np, s=None):
+    """JAX BookState (optionally batched, pick stream ``s``) -> the
+    oracle's canonical ((price, ((qty, oid), ...)), ...) per side."""
+    def half(price, qty, oid):
+        out = []
+        for i in range(price.shape[0]):
+            p = int(price[i])
+            slots = [
+                (int(qty[i, j]), int(oid[i, j]))
+                for j in range(qty.shape[1])
+                if int(qty[i, j]) > 0
+            ]
+            if p > 0 and slots:
+                out.append((p, slots))
+        return sorted(out)
+
+    pick = (lambda a: a[s]) if s is not None else (lambda a: a)
+    return (
+        half(pick(book_np.bid_price), pick(book_np.bid_qty), pick(book_np.bid_oid)),
+        half(pick(book_np.ask_price), pick(book_np.ask_qty), pick(book_np.ask_oid)),
+    )
+
+
+def _oracle_canonical(ob):
+    bids, asks = ob.canonical()
+    return (
+        sorted((p, [tuple(e) for e in lvl]) for p, lvl in bids),
+        sorted((p, [tuple(e) for e in lvl]) for p, lvl in asks),
+    )
+
+
+# ---------------------------------------------------------------------------
+# matching parity: JAX engine == Python oracle, exactly
+# ---------------------------------------------------------------------------
+def test_parity_4096_streams_exact():
+    """The acceptance contract: 4096 seeded streams through the vmapped
+    engine and the oracle, every per-message fill tuple and every final
+    book EXACTLY equal."""
+    n_streams, n_msgs = 4096, 24
+    fp = FlowParams()
+    streams = random_message_streams(
+        jax.random.PRNGKey(42), n_streams, n_msgs, fp
+    )
+    run = jax.jit(
+        jax.vmap(lambda m: process_stream(empty_book(DEPTH, QSLOTS), m))
+    )
+    books, fills = jax.device_get(run(streams))
+    msgs_np = [np.asarray(a) for a in streams]
+    fills_np = np.stack([np.asarray(f) for f in fills], axis=-1)  # (S, M, 9)
+
+    mismatched = 0
+    for s in range(n_streams):
+        ob, ofills = replay_messages(
+            DEPTH, QSLOTS, tuple(a[s] for a in msgs_np)
+        )
+        exp = np.asarray(ofills, dtype=np.int64)
+        if not (fills_np[s] == exp).all() \
+                or _canonical(books, s) != _oracle_canonical(ob):
+            mismatched += 1
+    assert mismatched == 0, f"{mismatched}/{n_streams} streams diverged"
+
+
+@pytest.mark.parametrize("scenario", scenario_names())
+def test_parity_every_scenario_flow_mix(scenario):
+    """Each scenario preset's message mix (incl. the flash-crash burst
+    window) replays exactly through both engines."""
+    fp = scenario_flow_params(scenario)
+    streams = random_message_streams(jax.random.PRNGKey(9), 64, 32, fp)
+    run = jax.jit(
+        jax.vmap(lambda m: process_stream(empty_book(DEPTH, QSLOTS), m))
+    )
+    books, fills = jax.device_get(run(streams))
+    msgs_np = [np.asarray(a) for a in streams]
+    fills_np = np.stack([np.asarray(f) for f in fills], axis=-1)
+    for s in range(64):
+        ob, ofills = replay_messages(
+            DEPTH, QSLOTS, tuple(a[s] for a in msgs_np)
+        )
+        np.testing.assert_array_equal(
+            fills_np[s], np.asarray(ofills, np.int64), err_msg=f"stream {s}"
+        )
+        assert _canonical(books, s) == _oracle_canonical(ob)
+
+
+# ---------------------------------------------------------------------------
+# matching-engine unit semantics
+# ---------------------------------------------------------------------------
+def _seeded_asks(levels=((101, 5), (102, 5), (103, 5))):
+    book = empty_book(DEPTH, QSLOTS)
+    for i, (p, q) in enumerate(levels):
+        book, _ = add_limit(book, False, jnp.int32(p), jnp.int32(q),
+                            jnp.int32(1000 + i))
+    return book
+
+
+def test_market_order_walks_depth_and_partial_fills():
+    book = _seeded_asks()
+    book, fill = match_market(book, True, jnp.int32(8))
+    assert int(fill.filled_qty) == 8
+    # depth-derived slippage: 5 @ 101 then 3 @ 102
+    assert int(fill.filled_value) == 5 * 101 + 3 * 102
+    assert int(fill.price_min) == 101 and int(fill.price_max) == 102
+    # the book dried up mid-walk: a 100-lot order only finds 7 lots
+    book, fill2 = match_market(book, True, jnp.int32(100))
+    assert int(fill2.filled_qty) == 7  # 2 @ 102 + 5 @ 103 — partial
+    assert int(fill2.filled_value) == 2 * 102 + 5 * 103
+
+
+def test_price_time_priority_fifo_within_level():
+    book = empty_book(DEPTH, QSLOTS)
+    book, _ = add_limit(book, False, jnp.int32(101), jnp.int32(4), jnp.int32(11))
+    book, _ = add_limit(book, False, jnp.int32(101), jnp.int32(4), jnp.int32(22))
+    book, _ = match_market(book, True, jnp.int32(6))
+    b = jax.device_get(book)
+    lvl = int(np.argmax(b.ask_price == 101))
+    # first-in order 11 fully consumed; 22 keeps the 2-lot remainder
+    # and compaction moved it to the front slot
+    assert int(b.ask_oid[lvl, 0]) == 22
+    assert int(b.ask_qty[lvl, 0]) == 2
+
+
+def test_agent_queue_position_behind_seed_depth():
+    """A resting agent order at an occupied level waits behind the
+    earlier quantity (price-time priority): takers smaller than the
+    queue ahead never touch the agent."""
+    book = empty_book(DEPTH, QSLOTS)
+    book, _ = add_limit(book, False, jnp.int32(101), jnp.int32(10), jnp.int32(7))
+    book, _ = add_limit(book, False, jnp.int32(101), jnp.int32(5), AGENT_OID)
+    book, fill = match_market(book, True, jnp.int32(8))
+    assert int(fill.filled_qty) == 8
+    assert int(fill.agent_qty) == 0  # queue ahead absorbed it
+    book, fill2 = match_market(book, True, jnp.int32(4))
+    # 2 lots drain the queue ahead, 2 reach the agent
+    assert int(fill2.agent_qty) == 2
+    assert int(fill2.agent_value) == 2 * 101
+
+
+def test_marketable_limit_fills_then_rests_remainder():
+    book = _seeded_asks(((101, 5),))
+    book, fill = add_limit(book, True, jnp.int32(102), jnp.int32(8), jnp.int32(5))
+    assert int(fill.filled_qty) == 5       # crossed at the maker's 101
+    assert int(fill.filled_value) == 5 * 101
+    assert int(fill.rested_qty) == 3       # remainder rests at 102 (bid)
+    b = jax.device_get(book)
+    assert (b.bid_price == 102).any()
+
+
+def test_cancel_removes_all_lots_for_oid():
+    book = empty_book(DEPTH, QSLOTS)
+    book, _ = add_limit(book, True, jnp.int32(99), jnp.int32(4), jnp.int32(5))
+    book, _ = add_limit(book, True, jnp.int32(98), jnp.int32(6), jnp.int32(5))
+    book, fill = cancel(book, True, jnp.int32(5))
+    assert int(fill.cancelled_qty) == 10
+    assert int(jax.device_get(book).bid_qty.sum()) == 0
+
+
+def test_fixed_capacity_drops_overflow():
+    d, q = 4, 2
+    book = empty_book(d, q)
+    # fill every level
+    for i in range(d):
+        book, fill = add_limit(book, False, jnp.int32(200 + i), jnp.int32(1),
+                               jnp.int32(10 + i))
+        assert int(fill.rested_qty) == 1
+    # a NEW price on a full side is dropped
+    book, fill = add_limit(book, False, jnp.int32(300), jnp.int32(1),
+                           jnp.int32(99))
+    assert int(fill.rested_qty) == 0
+    # an EXISTING price still queues until its slots fill
+    book, fill = add_limit(book, False, jnp.int32(200), jnp.int32(1),
+                           jnp.int32(50))
+    assert int(fill.rested_qty) == 1
+    book, fill = add_limit(book, False, jnp.int32(200), jnp.int32(1),
+                           jnp.int32(51))
+    assert int(fill.rested_qty) == 0  # queue full: dropped
+
+
+# ---------------------------------------------------------------------------
+# flow determinism + scenario family
+# ---------------------------------------------------------------------------
+def test_flow_streams_deterministic_and_seed_sensitive():
+    a = lambda x: jnp.asarray(x, jnp.int32)
+    fp = FlowParams()
+    m1 = bar_messages(bar_key(3, 17), a(110000), a(110040), a(109980),
+                      a(110020), 32, fp)
+    m2 = bar_messages(bar_key(3, 17), a(110000), a(110040), a(109980),
+                      a(110020), 32, fp)
+    m3 = bar_messages(bar_key(4, 17), a(110000), a(110040), a(109980),
+                      a(110020), 32, fp)
+    for x, y in zip(m1, m2):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert any(
+        not np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(m1, m3)
+    )
+    # prices always stay off the empty-level sentinel and inside the cap
+    assert int(jnp.min(m1.price)) >= 1
+    assert int(jnp.max(m1.price)) < PRICE_CAP
+
+
+def test_scenarios_produce_distinct_flow():
+    a = lambda x: jnp.asarray(x, jnp.int32)
+    key = bar_key(11, 5)
+    streams = {
+        name: bar_messages(key, a(110000), a(110040), a(109980), a(110020),
+                           64, scenario_flow_params(name))
+        for name in scenario_names()
+    }
+    assert len(streams) == 5
+    calm = streams["lob_calm"]
+    for name, m in streams.items():
+        if name == "lob_calm":
+            continue
+        assert any(
+            not np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(calm, m)
+        ), f"{name} flow identical to lob_calm"
+    # the flash-crash burst is a contiguous forced market-sell window
+    fp = scenario_flow_params("lob_flash_crash")
+    m = streams["lob_flash_crash"]
+    w = slice(int(fp.crash_at), int(fp.crash_at) + int(fp.crash_len))
+    assert (np.asarray(m.kind)[w] == 3).all()
+    assert (np.asarray(m.side)[w] == -1).all()
+
+
+def test_thin_book_costs_more_than_calm():
+    """Scenario economics: the same 40-lot orders walk deeper into a
+    thin book (seed_qty 4 vs 16), so lob_thin realizes a worse balance
+    than lob_calm on the same bars and decisions."""
+    from gymfx_tpu.core import broker
+    from gymfx_tpu.core.runtime import Environment
+
+    balances = {}
+    for scen in ("lob_calm", "lob_thin"):
+        env = Environment(_sample_config(
+            driver_mode="random", position_size=40.0, lob_lot_units=1.0,
+            lob_scenario=scen,
+        ))
+        state, _ = env.rollout(env.make_driver(), 60, seed=5)
+        balances[scen] = float(np.asarray(jax.device_get(
+            broker.realized_balance(state, env.params)
+        )))
+    assert balances["lob_thin"] < balances["lob_calm"], balances
+
+
+# ---------------------------------------------------------------------------
+# venue semantics through the env
+# ---------------------------------------------------------------------------
+def test_entry_vwap_reflects_depth_walk():
+    closes = [1.1] * 12
+    env = make_env(
+        make_df(closes), venue="lob", position_size=40.0, lob_lot_units=1.0,
+    )
+    state, _ = env.reset()
+    state, *_ = env.step(state, 1)
+    state, *_ = env.step(state, 0)
+    assert float(state.pos) == 40.0
+    # seed book at o=110000 ticks: asks 16@110001, 16@110002, 8@110003
+    value = 16 * 110001 + 16 * 110002 + 8 * 110003
+    expected = np.float32(np.float32(value) / np.float32(40.0)) * np.float32(1e-5)
+    assert float(state.entry_price) == pytest.approx(float(expected), rel=1e-6)
+    # strictly worse than the touch — depth-derived slippage is real
+    assert float(state.entry_price) > 1.1 + 1e-5
+
+
+def test_sub_lot_order_denied_with_counter():
+    from gymfx_tpu.core.types import EXEC_DIAG_INDEX
+
+    closes = [1.1] * 12
+    env = make_env(
+        make_df(closes), venue="lob", position_size=1.0, lob_lot_units=3.0,
+    )
+    state, _ = env.reset()
+    state, *_ = env.step(state, 1)
+    state, *_ = env.step(state, 0)
+    assert float(state.pos) == 0.0
+    assert int(state.exec_diag[EXEC_DIAG_INDEX["order_denied_min_quantity"]]) == 1
+
+
+def test_gap_open_through_stop_exits_at_open_walk():
+    """A bar that gaps open through the armed SL flattens at the open's
+    book walk (not at the stop price) — the gap-risk semantics."""
+    closes = [1.1] * 4 + [1.0] * 6
+    env = make_env(
+        make_df(closes), venue="lob",
+        strategy_plugin="direct_fixed_sltp", sl_pips=10.0, tp_pips=500.0,
+        position_size=1.0,
+    )
+    state, _ = env.reset()
+    state, *_ = env.step(state, 1)      # submit long
+    state, *_ = env.step(state, 0)      # fills at bar-2 open 1.1, arms SL
+    assert float(state.pos) == 1.0
+    assert float(state.bracket_sl) == pytest.approx(1.099, abs=1e-6)
+    state, *_ = env.step(state, 0)      # bar 3 @ 1.1: no trigger
+    assert float(state.pos) == 1.0
+    state, *_ = env.step(state, 0)      # advance to the gap bar
+    state, *_ = env.step(state, 0)      # bar 4 opens 1.0 < SL: gap exit
+    assert float(state.pos) == 0.0
+    assert float(state.bracket_sl) == 0.0
+    from gymfx_tpu.core import broker
+
+    bal = float(np.asarray(broker.realized_balance(state, env.params)))
+    # exited near the 1.0 open (best bid 0.99999), NOT at the 1.099 stop
+    assert bal == pytest.approx(10000.0 - (1.1 - 0.99999), abs=2e-3)
+
+
+def test_bar_venue_bitwise_identical_across_lob_knobs():
+    """venue="bar" (the default) must not read ANY lob_* knob: traces
+    and final states are bitwise identical across wildly different LOB
+    settings."""
+    rng = np.random.default_rng(3)
+    closes = 1.1 + np.cumsum(rng.normal(0, 2e-4, 40))
+    df = make_df(closes, highs=closes + 3e-4, lows=closes - 3e-4)
+
+    def run(**knobs):
+        env = make_env(df, driver_mode="random", **knobs)
+        state, trace = env.rollout(env.make_driver(), 30, seed=2)
+        return jax.device_get((state, trace))
+
+    s1, t1 = run()
+    s2, t2 = run(
+        lob_depth_levels=64, lob_queue_slots=8, lob_messages_per_bar=16,
+        lob_flow_seed=99, lob_scenario="lob_flash_crash",
+        lob_tick_size=1e-4, lob_lot_units=7.0,
+    )
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in t1:
+        np.testing.assert_array_equal(
+            np.asarray(t1[k]), np.asarray(t2[k]), err_msg=k
+        )
+
+
+def test_lob_flow_seed_changes_execution():
+    """The flow seed is honored: 40-lot entries meet differently
+    replenished books, so realized balances differ across seeds."""
+    from gymfx_tpu.core import broker
+    from gymfx_tpu.core.runtime import Environment
+
+    def bal(flow_seed):
+        # tight stops on a volatile flow: the SL fires mid-stream and
+        # walks a flow-modified book, so the fill depends on the flow
+        env = Environment(_sample_config(
+            driver_mode="random", position_size=40.0, lob_lot_units=1.0,
+            sl_pips=0.5, tp_pips=5.0, strategy_plugin="direct_fixed_sltp",
+            lob_scenario="lob_volatile", lob_flow_seed=flow_seed,
+        ))
+        state, _ = env.rollout(env.make_driver(), 60, seed=5)
+        return float(np.asarray(jax.device_get(
+            broker.realized_balance(state, env.params)
+        )))
+
+    assert bal(0) != bal(12345)
+
+
+# ---------------------------------------------------------------------------
+# honor-or-reject config validation
+# ---------------------------------------------------------------------------
+def test_validation_rejects_unhonorable_knobs():
+    from gymfx_tpu.core.runtime import Environment
+
+    for bad, match in (
+        ({"slippage": 0.001}, "slippage"),
+        ({"venue_quantization": True}, "venue_quantization"),
+        ({"intrabar_collision_policy": "ohlc"}, "collision"),
+        ({"limit_fill_policy": "conservative"}, "limit_fill_policy"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            Environment(_sample_config(**bad))
+    # the same knobs are fine on the bar venue
+    Environment(_sample_config(venue="bar", slippage=0.001))
+
+
+def test_config_validation_rejects_bad_lob_values():
+    from gymfx_tpu.core.types import make_env_config
+
+    with pytest.raises(ValueError, match="venue"):
+        make_env_config(dict(DEFAULT_VALUES, venue="dark_pool"), n_bars=500)
+    with pytest.raises(ValueError, match="lob_depth_levels"):
+        make_env_config(
+            dict(DEFAULT_VALUES, venue="lob", lob_depth_levels=1), n_bars=500
+        )
+    with pytest.raises(ValueError, match="scenario"):
+        make_env_config(
+            dict(DEFAULT_VALUES, venue="lob", lob_scenario="lob_nope"),
+            n_bars=500,
+        )
+
+
+def test_cli_accepts_lob_flags():
+    from gymfx_tpu.config.cli import parse_args
+
+    args, _ = parse_args([
+        "--venue", "lob", "--lob_depth_levels", "32",
+        "--lob_scenario", "lob_thin", "--lob_flow_seed", "5",
+    ])
+    assert args.venue == "lob"
+    assert args.lob_depth_levels == 32
+    assert args.lob_scenario == "lob_thin"
+
+
+# ---------------------------------------------------------------------------
+# crosscheck: the third engine reconciles against the oracle replay
+# ---------------------------------------------------------------------------
+def test_crosscheck_lob_reconciles_bracketed_episode():
+    from gymfx_tpu.simulation.crosscheck import crosscheck_lob_episode
+
+    result = crosscheck_lob_episode(
+        _sample_config(
+            driver_mode="random", steps=80,
+            strategy_plugin="direct_fixed_sltp",
+            sl_pips=40.0, tp_pips=40.0, commission=0.0002,
+            lob_messages_per_bar=32, lob_flow_seed=7,
+        ),
+        seed=3,
+    )
+    assert result["schema"] == "lob_crosscheck.v1"
+    assert result["scan_trades"] > 3
+    assert result["within_bound"], result
+    assert result["denied_match"], result
+    assert result["quantization_bound"] < 1.0  # meaningful, not vacuous
+
+
+def test_crosscheck_lob_denied_episode_is_exact():
+    """Every order sub-lot: nothing ever trades, both denial counters
+    advance in lockstep, and with no fills the ledgers agree exactly."""
+    from gymfx_tpu.simulation.crosscheck import crosscheck_lob_episode
+
+    result = crosscheck_lob_episode(
+        _sample_config(
+            driver_mode="random", steps=60, lob_lot_units=3.0,
+            position_size=1.0,
+        ),
+        seed=1,
+    )
+    assert result["scan_denied"] > 0
+    assert result["denied_match"], result
+    assert result["divergence"] == 0.0
+    assert result["scan_trades"] == 0
+
+
+def test_crosscheck_engines_reject_wrong_venue():
+    from gymfx_tpu.simulation.crosscheck import (
+        crosscheck_episode,
+        crosscheck_lob_episode,
+    )
+
+    with pytest.raises(ValueError, match="crosscheck_lob_episode"):
+        crosscheck_episode(_sample_config(), [0])
+    with pytest.raises(ValueError, match="venue=lob"):
+        crosscheck_lob_episode(
+            dict(DEFAULT_VALUES, input_data_file=DATA, venue="bar")
+        )
+
+
+# ---------------------------------------------------------------------------
+# training on the lob_* scenario family
+# ---------------------------------------------------------------------------
+def test_ppo_trains_on_lob_scenario():
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    config = _sample_config(
+        num_envs=8, window_size=8, policy="mlp",
+        policy_kwargs={"hidden": [16, 16]},
+        ppo_horizon=8, ppo_epochs=1, ppo_minibatches=2,
+        lob_messages_per_bar=16, lob_scenario="lob_volatile",
+    )
+    env = Environment(config)
+    trainer = PPOTrainer(env, ppo_config_from(config))
+    state = trainer.init_state(0)
+    state, metrics = trainer.train_step(state)
+    jax.block_until_ready(state)
+    for leaf in jax.tree.leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert np.isfinite(float(np.asarray(metrics["loss"])))
