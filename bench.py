@@ -108,6 +108,72 @@ def lob_main(args) -> None:
     )
 
 
+def scengen_main(args) -> None:
+    """``--scengen``: generative scenario engine bars/sec sweep — one
+    schema-valid ``scengen_bars_per_sec`` JSON line (docs/scenarios.md).
+
+    Workload: the full generation dispatch (shock draws + the scanned
+    regime/overlay transform, engine.generate) per preset at a fixed
+    (n_bars, n_assets) shape; the headline row is the first preset in
+    ``--scengen_presets`` and every preset lands in ``preset_sweep``.
+    """
+    import time
+
+    from gymfx_tpu.bench_util import probe_device
+
+    probe_device("scengen_bars_per_sec", unit="generated bars/sec/chip")
+
+    import jax
+
+    from gymfx_tpu.scengen.engine import generate
+    from gymfx_tpu.scengen.params import scenario_params
+
+    n_bars, n_assets, iters = (
+        args.scengen_bars, args.scengen_assets, args.iters
+    )
+    presets = [p for p in args.scengen_presets.split(",") if p.strip()]
+    if args.quick:
+        n_bars, n_assets, iters = 4096, 1, 2
+        presets = ["regime_mix", "flash_crash"]
+    key = jax.random.PRNGKey(0)
+
+    sweep = {}
+    for preset in presets:
+        p = scenario_params(preset)
+        paths = generate(p, key, n_bars, n_assets)  # compile + warmup
+        jax.block_until_ready(paths.close)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            paths = generate(p, key, n_bars, n_assets)
+        jax.block_until_ready(paths.close)
+        per_dispatch = (time.perf_counter() - t0) / iters
+        sweep[preset] = {
+            "bars_per_sec": round(n_bars * n_assets / per_dispatch, 1),
+            "gen_ms": round(per_dispatch * 1e3, 3),
+        }
+
+    head = sweep[presets[0]]
+    print(
+        json.dumps(
+            {
+                "metric": "scengen_bars_per_sec",
+                "value": head["bars_per_sec"],
+                "unit": (
+                    "generated bars/sec/chip (scanned regime/overlay "
+                    f"transform, {n_assets} asset(s), "
+                    f"preset={presets[0]})"
+                ),
+                "bars_per_sec_per_chip": head["bars_per_sec"],
+                "gen_ms": head["gen_ms"],
+                "n_bars": n_bars,
+                "n_assets": n_assets,
+                "preset": presets[0],
+                "preset_sweep": sweep,
+            }
+        )
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n_envs", type=int, default=8192)
@@ -138,9 +204,25 @@ def main() -> None:
         "--depths", type=str, default="8,16,24,48",
         help="comma-separated book depths for the --lob sweep",
     )
+    # generative scenario engine sweep (docs/scenarios.md)
+    ap.add_argument(
+        "--scengen", action="store_true",
+        help="benchmark the scenario generator instead of PPO "
+             "(emits a scengen_bars_per_sec record)",
+    )
+    ap.add_argument("--scengen_bars", type=int, default=65536)
+    ap.add_argument("--scengen_assets", type=int, default=4)
+    ap.add_argument(
+        "--scengen_presets", type=str,
+        default="regime_mix,flash_crash,liquidity_drought,gap_open",
+        help="comma-separated presets for the --scengen sweep "
+             "(first = headline row)",
+    )
     args = ap.parse_args()
     if args.lob:
         return lob_main(args)
+    if args.scengen:
+        return scengen_main(args)
     if args.quick:
         args.n_envs, args.horizon, args.iters = 256, 32, 2
 

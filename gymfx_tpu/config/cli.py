@@ -50,6 +50,18 @@ def parse_args(argv=None):
     parser.add_argument("--lob_tick_size", type=float)
     parser.add_argument("--lob_lot_units", type=float)
 
+    # data feed: replayed CSV tape vs the generative scenario engine
+    # (docs/scenarios.md)
+    parser.add_argument("--feed", choices=["replay", "scengen"])
+    parser.add_argument(
+        "--scengen_preset",
+        choices=["regime_mix", "trend_calm", "range_chop", "flash_crash",
+                 "gap_open", "liquidity_drought", "multi_asset_calm",
+                 "multi_asset_stress"],
+    )
+    parser.add_argument("--scengen_bars", type=int)
+    parser.add_argument("--scengen_seed", type=int)
+
     parser.add_argument("--replay_actions_file", type=str)
     parser.add_argument("--results_file", type=str)
     parser.add_argument("--load_config", type=str)

@@ -56,6 +56,17 @@ DEFAULT_VALUES = {
     "lob_scenario": "lob_calm",  # lob_calm|lob_trend|lob_volatile|lob_thin|lob_flash_crash
     "lob_tick_size": 1e-5,       # quote-currency size of one book tick
     "lob_lot_units": 0.0,        # units per lot (0 = position_size)
+    # data feed: "replay" = the CSV tape (input_data_file); "scengen" =
+    # the seed-deterministic generative scenario engine
+    # (gymfx_tpu/scengen/, docs/scenarios.md) — same MarketData pipeline,
+    # no file needed
+    "feed": "replay",
+    "scengen_preset": "regime_mix",  # scengen/params.py preset registry
+    "scengen_bars": 2048,            # generated tape length in bars
+    "scengen_seed": 0,               # generation PRNG seed (decoupled
+                                     # from the training seed)
+    "scengen_pairs": None,           # portfolio pair list (None = the
+                                     # default 4 USD-quote pairs)
     "action_space_mode": "discrete",  # discrete|continuous
     "continuous_action_threshold": 0.33,
     "seed": 0,

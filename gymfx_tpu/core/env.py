@@ -154,7 +154,17 @@ def step(
         # and no LOB code reaches the hot path.
         from gymfx_tpu.lob import venue as lob_venue
 
-        st_l = lob_venue.execute_bar(st, o, h, l, c, t_new, cfg, params)
+        # feed=scengen: the generated tape's per-bar scenario bitmask
+        # reshapes the order flow (droughts thin the book, crash bars
+        # burst the flow) — static gate, so replay feeds never trace
+        # the scen_flags leaf
+        scen = (
+            data.scen_flags[t_new - r0] if cfg.lob_flow_from_scengen
+            else None
+        )
+        st_l = lob_venue.execute_bar(
+            st, o, h, l, c, t_new, cfg, params, scen_flags=scen
+        )
         st = _select(advance, st_l, st)
     else:
         # 1. pending order fills at the new bar's open (only when advancing)

@@ -526,17 +526,28 @@ class PortfolioEnvironment:
         last ``frac`` of the ALIGNED bars is the eval part, so the two
         parts never share a bar on any pair (train/common.py
         build_portfolio_train_eval_envs)."""
-        files = config.get("portfolio_files")
-        if not files:
-            raise ValueError("portfolio env requires config['portfolio_files']")
         self.config = dict(config)
         account = str(config.get("account_currency", "USD"))
-        pairs, aligned = load_portfolio_frames(
-            dict(files),
-            date_column=str(config.get("date_column", "DATE_TIME")),
-            price_column=str(config.get("price_column", "CLOSE")),
-            max_rows=config.get("max_rows"),
-        )
+        feed = str(config.get("feed") or "replay").lower()
+        if feed == "scengen":
+            # correlated multi-asset generation on one shared grid —
+            # already aligned, no timestamp join needed
+            from gymfx_tpu.scengen.feed import synthesize_portfolio_frames
+
+            pairs, aligned, _flags = synthesize_portfolio_frames(config)
+        else:
+            files = config.get("portfolio_files")
+            if not files:
+                raise ValueError(
+                    "portfolio env requires config['portfolio_files'] "
+                    "(or feed=scengen for a generated book)"
+                )
+            pairs, aligned = load_portfolio_frames(
+                dict(files),
+                date_column=str(config.get("date_column", "DATE_TIME")),
+                price_column=str(config.get("price_column", "CLOSE")),
+                max_rows=config.get("max_rows"),
+            )
         self.pairs = pairs
         w = int(config.get("window_size", 32))
         if split is not None:

@@ -87,7 +87,21 @@ def _parse_column_list(value: Any, key: str) -> list:
 class Environment:
     def __init__(self, config: Dict[str, Any], dataset: Optional[MarketDataset] = None):
         self.config = dict(config)
-        self.dataset = dataset or load_market_dataset(self.config)
+        # feed dispatch: "replay" (the default — bitwise-identical code
+        # path when the knob is unset) loads the CSV dataset; "scengen"
+        # synthesizes a seed-deterministic scenario tape through the
+        # SAME MarketDataset pipeline (gymfx_tpu/scengen/, docs/scenarios.md)
+        feed = str(config.get("feed") or "replay").lower()
+        if dataset is not None:
+            self.dataset = dataset
+        elif feed == "replay":
+            self.dataset = load_market_dataset(self.config)
+        elif feed == "scengen":
+            from gymfx_tpu.scengen.feed import ScenGenDataset
+
+            self.dataset = ScenGenDataset(self.config)
+        else:
+            raise ValueError(f"feed must be replay|scengen, got {feed!r}")
         if len(self.dataset) < int(config.get("window_size", 32)) + 2:
             raise ValueError(
                 "input data is empty or too short for the configured window"

@@ -121,6 +121,11 @@ class EnvConfig:
     lob_scenario: str = "lob_calm"           # lob/scenarios.py preset
     lob_tick_size: float = 1e-5              # quote-currency size of one tick
     lob_lot_units: float = 0.0               # units per lot (0 = position_size)
+    # feed=scengen + venue=lob: derive per-bar FlowParams from the
+    # generated tape's scen_flags (lob/scenarios.flow_params_from_regime)
+    # so droughts thin the book and crash bars burst the flow.  Static:
+    # when off (every replay feed) the scen_flags leaf is never traced.
+    lob_flow_from_scengen: bool = False
 
     intrabar_collision_policy: str = "worst_case"  # worst_case | adaptive | ohlc
     # "cross" (price-improving gap fills) is the scan engine's historical
@@ -450,6 +455,10 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         lob_scenario=str(config.get("lob_scenario", "lob_calm")),
         lob_tick_size=float(config.get("lob_tick_size", 1e-5)),
         lob_lot_units=float(config.get("lob_lot_units", 0.0)),
+        lob_flow_from_scengen=(
+            str(config.get("feed") or "replay").lower() == "scengen"
+            and str(config.get("venue", "bar")).lower() == "lob"
+        ),
         intrabar_collision_policy=collision,
         limit_fill_policy=limit_fill,
         slip_open=bool(config.get("slip_open", True)),

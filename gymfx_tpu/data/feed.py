@@ -58,6 +58,12 @@ class MarketData(NamedTuple):
     # cursors (state.t) and rebase every array read by -row0 — one
     # compiled program serves every shard.
     row0: Any = 0
+    # (n,) int32 per-bar scenario bitmask (scengen/params.py FLAG_*):
+    # zeros on every replayed feed; generated feeds carry the active
+    # regime/overlay so venue=lob can thin its flow with the tape.
+    # Reads are gated behind the static lob_flow_from_scengen config
+    # flag, so replay-path programs never trace this leaf.
+    scen_flags: Any = 0
 
     @property
     def n_bars(self) -> int:
@@ -237,6 +243,7 @@ class MarketDataset:
             feat_std=A(feat_std, f32),
             feat_neutral=A(feat_neutral, bool),
             row0=np.int32(0),
+            scen_flags=A(np.zeros(n, np.int32), np.int32),
         )
 
 
@@ -364,6 +371,7 @@ def shard_market_data(data: MarketData, start: int, shard_bars: int,
         feat_std=data.feat_std[feat],
         feat_neutral=data.feat_neutral[feat],
         row0=np.int32(start),
+        scen_flags=data.scen_flags[bar],
     )
 
 

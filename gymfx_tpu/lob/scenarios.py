@@ -23,6 +23,8 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax.numpy as jnp
+
 from .flow import FlowParams
 
 _SCENARIOS: Dict[str, FlowParams] = {
@@ -57,3 +59,45 @@ def scenario_flow_params(name: str) -> FlowParams:
         raise ValueError(
             f"unknown lob_scenario {name!r}; known: {scenario_names()}"
         ) from None
+
+
+def flow_params_from_regime(base: FlowParams, scen_flags,
+                            n_msgs: int) -> FlowParams:
+    """Per-bar FlowParams from the generated tape's scenario bitmask
+    (``feed=scengen`` + ``venue=lob``): drought bars take the
+    ``lob_thin`` intensity/depth mix so the book thins WITH the tape's
+    spread blowout, and crash bars arm the ``lob_flash_crash`` forced
+    sell burst so the flow prints the drop the bars show.  Everything is
+    ``jnp.where``-blended — FlowParams fields are traced pytree leaves,
+    so this stays inside the one compiled bar program.
+
+    Flag bits come from scengen/params.py; the import is local so the
+    LOB package stays importable without the scengen subsystem loaded.
+    """
+    from gymfx_tpu.scengen.params import FLAG_CRASH, FLAG_DROUGHT
+
+    flags = jnp.asarray(scen_flags, jnp.int32)
+    thin = _SCENARIOS["lob_thin"]
+    crash = _SCENARIOS["lob_flash_crash"]
+    in_drought = (flags & FLAG_DROUGHT) != 0
+    in_crash = (flags & FLAG_CRASH) != 0
+
+    def mix(b, t):
+        return jnp.where(in_drought, t, jnp.asarray(b))
+
+    burst_at = jnp.int32(max(0, int(n_msgs) // 3))
+    burst_len = jnp.int32(max(1, int(n_msgs) // 8))
+    return FlowParams(
+        p_add=mix(base.p_add, thin.p_add),
+        p_cancel=mix(base.p_cancel, thin.p_cancel),
+        p_noop=mix(base.p_noop, thin.p_noop),
+        base_qty=mix(base.base_qty, thin.base_qty),
+        qty_jitter=mix(base.qty_jitter, thin.qty_jitter),
+        band_ticks=mix(base.band_ticks, thin.band_ticks),
+        market_qty=mix(base.market_qty, thin.market_qty),
+        seed_qty=mix(base.seed_qty, thin.seed_qty),
+        crash_at=jnp.where(in_crash, burst_at, jnp.asarray(base.crash_at)),
+        crash_len=jnp.where(in_crash, burst_len, jnp.asarray(base.crash_len)),
+        crash_qty=jnp.where(in_crash, jnp.asarray(crash.crash_qty),
+                            jnp.asarray(base.crash_qty)),
+    )

@@ -107,13 +107,26 @@ def _walk_with_backstop(book: BookState, is_buy, lots, backstop_ticks):
 
 
 def execute_bar(
-    state: EnvState, o, h, l, c, t_global, cfg: EnvConfig, params: EnvParams
+    state: EnvState, o, h, l, c, t_global, cfg: EnvConfig, params: EnvParams,
+    scen_flags=None,
 ) -> EnvState:
     """One advancing bar through the LOB venue (replaces fill_pending +
-    check_brackets; the caller gates with its ``advance`` select)."""
+    check_brackets; the caller gates with its ``advance`` select).
+
+    ``scen_flags`` (feed=scengen only): the bar's scenario bitmask —
+    the static FlowParams preset is blended per bar so the flow thins
+    in droughts and bursts through crash windows
+    (scenarios.flow_params_from_regime).
+    """
     d = state.pos.dtype
     tick = cfg.lob_tick_size
     fp = scenario_flow_params(cfg.lob_scenario)
+    if scen_flags is not None:
+        from .scenarios import flow_params_from_regime
+
+        fp = flow_params_from_regime(
+            fp, scen_flags, cfg.lob_messages_per_bar
+        )
 
     o_t = price_to_ticks(o, tick)
     c_t = price_to_ticks(c, tick)

@@ -39,7 +39,14 @@ Profile grammar — semicolon-separated ``key=value`` clauses::
                         (consumed by bench_infer.py's chaos phase)
   preempt_at            iteration index after which the trainer raises
                         SimulatedPreemptionError (checkpoint drill)
+  scengen               a scengen preset name (``scengen=flash_crash``):
+                        overlays the preset's STRUCTURED market stress —
+                        crash drops with recovery tails, drought spread
+                        blowouts, gap level shifts — onto the training
+                        feed (gymfx_tpu/scengen/stress.py), so chaos
+                        runs fuzz with market moves, not only NaNs
   seed                  seed for probabilistic plans (``transport=p0.3``)
+                        and the scengen stress layout
 """
 from __future__ import annotations
 
@@ -358,6 +365,7 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
         "serve_rate": 0.0,
         "burst": None,
         "preempt_at": None,
+        "scengen": None,
         "seed": 0,
     }
     if not spec:
@@ -405,20 +413,35 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
                 )
         elif key == "preempt_at":
             profile["preempt_at"] = int(val)
+        elif key == "scengen":
+            # honor-or-reject at parse time (params is numpy-only, so
+            # this stays importable from jax-free serving contexts)
+            from gymfx_tpu.scengen.params import scenario_params
+
+            scenario_params(val)
+            profile["scengen"] = val
         elif key == "seed":
             profile["seed"] = int(val)
         else:
             raise ValueError(
                 f"unknown fault_profile key {key!r}; known: nan_bars, "
                 "inf_bars, fields, transport, serve, burst, preempt_at, "
-                "seed"
+                "scengen, seed"
             )
     return profile
 
 
 def apply_fault_profile_to_market_data(data: Any, profile: Dict[str, Any]) -> Any:
     """Apply the feed-contamination part of a parsed profile (transport
-    and preemption faults are wired where those subsystems live)."""
+    and preemption faults are wired where those subsystems live).
+    Scengen stress goes first so NaN/inf clauses can poison the
+    stressed bars too."""
+    if profile.get("scengen"):
+        from gymfx_tpu.scengen.stress import apply_scengen_stress
+
+        data = apply_scengen_stress(
+            data, profile["scengen"], seed=int(profile.get("seed", 0))
+        )
     if profile.get("nan_bars"):
         data = contaminate_market_data(
             data, bars=profile["nan_bars"],

@@ -1,0 +1,260 @@
+"""Pure-JAX generative market engine: seeded shocks -> OHLC scenario paths.
+
+Split into two stages so determinism and testability fall out of the
+structure instead of discipline:
+
+  ``draw_shocks``       every random number the generator will ever use,
+                        drawn up front from ONE ``jax.random`` key with a
+                        fixed split order (threefry is backend-stable, so
+                        CPU tests pin TPU behavior — the same contract as
+                        lob/flow.py);
+  ``paths_from_shocks`` a deterministic transform: one ``lax.scan`` over
+                        bars carrying (regime, log price, crash/recovery/
+                        drought counters), vectorized over assets with
+                        Cholesky-mixed correlated shocks.
+
+The NumPy oracle twin (oracle.py) consumes the SAME drawn shocks through
+an independently written loop, so any disagreement is a transform bug,
+not a PRNG mismatch.  Decision-critical comparisons (regime transitions,
+overlay starts) use explicitly-sequenced f32 arithmetic in both
+implementations, so regimes and flags match EXACTLY while prices agree
+to float tolerance.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .params import (
+    FLAG_CRASH,
+    FLAG_DROUGHT,
+    FLAG_GAP,
+    FLAG_HIGHVOL,
+    FLAG_TREND,
+    HIGHVOL,
+    N_REGIMES,
+    TREND_DOWN,
+    TREND_UP,
+    ScenarioParams,
+)
+
+
+class Shocks(NamedTuple):
+    """Every random draw the generator consumes, time-major."""
+
+    regime_u: Any   # (n,)    uniform — regime transition draw
+    ret_z: Any      # (n, A)  normal — per-asset return shocks (pre-mix)
+    gap_z: Any      # (n, A)  normal — per-asset gap magnitudes
+    hi_z: Any       # (n, A)  normal — high-wick extension
+    lo_z: Any       # (n, A)  normal — low-wick extension
+    crash_u: Any    # (n,)    uniform — crash start draw
+    gap_u: Any      # (n,)    uniform — random gap-open draw
+    drought_u: Any  # (n,)    uniform — drought start draw
+
+
+class ScenPaths(NamedTuple):
+    """Generated tape: OHLC per asset plus the scenario channels."""
+
+    open: Any         # (n, A) float32
+    high: Any         # (n, A)
+    low: Any          # (n, A)
+    close: Any        # (n, A)
+    spread_mult: Any  # (n,) float32 — event-overlay spread multiplier
+    slip_mult: Any    # (n,) float32 — event-overlay slippage multiplier
+    flags: Any        # (n,) int32 — FLAG_* bitmask per bar
+    regime: Any       # (n,) int32 — active regime state per bar
+
+
+def draw_shocks(key, n_bars: int, n_assets: int) -> Shocks:
+    """All randomness up front, fixed split order (the determinism pin:
+    same key + same shapes => bitwise-identical shocks on every
+    backend/process)."""
+    ks = jax.random.split(key, 8)
+    f32 = jnp.float32
+    return Shocks(
+        regime_u=jax.random.uniform(ks[0], (n_bars,), f32),
+        ret_z=jax.random.normal(ks[1], (n_bars, n_assets), f32),
+        gap_z=jax.random.normal(ks[2], (n_bars, n_assets), f32),
+        hi_z=jax.random.normal(ks[3], (n_bars, n_assets), f32),
+        lo_z=jax.random.normal(ks[4], (n_bars, n_assets), f32),
+        crash_u=jax.random.uniform(ks[5], (n_bars,), f32),
+        gap_u=jax.random.uniform(ks[6], (n_bars,), f32),
+        drought_u=jax.random.uniform(ks[7], (n_bars,), f32),
+    )
+
+
+def correlation_cholesky(corr, n_assets: int):
+    """Cholesky factor of the equicorrelated (A, A) shock-mixing matrix
+    ``(1 - rho) I + rho J`` (tiny, computed once per generation)."""
+    f32 = jnp.float32
+    rho = jnp.asarray(corr, f32)
+    eye = jnp.eye(n_assets, dtype=f32)
+    cmat = (1.0 - rho) * eye + rho * jnp.ones((n_assets, n_assets), f32)
+    return jnp.linalg.cholesky(cmat)
+
+
+def paths_from_shocks(
+    shocks: Shocks, p: ScenarioParams, monday_open
+) -> ScenPaths:
+    """Deterministic transform: shocks + params + weekend mask -> tape.
+
+    ``monday_open`` is a (n,) bool mask of bars that open after a
+    weekend close (feed.fx_timestamp_grid); zeros when the tape has no
+    calendar (bench).
+    """
+    f32 = jnp.float32
+    i32 = jnp.int32
+    n, n_assets = shocks.ret_z.shape
+
+    trans = jnp.asarray(p.trans, f32)
+    drift = jnp.asarray(p.drift, f32)
+    vol = jnp.asarray(p.vol, f32)
+    spread = jnp.asarray(p.spread, f32)
+    hl_range = jnp.asarray(p.hl_range, f32)
+    p_crash = jnp.asarray(p.p_crash, f32)
+    crash_len = jnp.asarray(p.crash_len, i32)
+    crash_drop = jnp.asarray(p.crash_size, f32) / jnp.maximum(
+        jnp.asarray(p.crash_len, f32), 1.0
+    )
+    recovery_len = jnp.asarray(p.recovery_len, i32)
+    recov_gain = (
+        jnp.asarray(p.crash_size, f32) * jnp.asarray(p.recovery_frac, f32)
+    ) / jnp.maximum(jnp.asarray(p.recovery_len, f32), 1.0)
+    crash_spread = jnp.asarray(p.crash_spread, f32)
+    p_gap = jnp.asarray(p.p_gap, f32)
+    gap_size = jnp.asarray(p.gap_size, f32)
+    weekend_gap_size = jnp.asarray(p.weekend_gap_size, f32)
+    p_drought = jnp.asarray(p.p_drought, f32)
+    drought_len = jnp.asarray(p.drought_len, i32)
+    drought_spread = jnp.asarray(p.drought_spread, f32)
+    drought_vol = jnp.asarray(p.drought_vol, f32)
+
+    chol = correlation_cholesky(p.corr, n_assets)
+    eps = shocks.ret_z @ chol.T  # (n, A) correlated return shocks
+
+    monday = jnp.asarray(monday_open, bool)
+    s0 = jnp.broadcast_to(jnp.asarray(p.s0, f32), (n_assets,))
+
+    def step(carry, x):
+        regime, logp, crash_left, recov_left, drought_left = carry
+        (u_reg, z_eps, z_gap, z_hi, z_lo, u_crash, u_gap, u_drought,
+         is_monday) = x
+
+        # regime transition — explicitly-sequenced f32 partial sums so
+        # the NumPy oracle reproduces borderline draws EXACTLY
+        row = trans[regime]
+        c0 = row[0]
+        c1 = c0 + row[1]
+        c2 = c1 + row[2]
+        regime = jnp.where(
+            u_reg < c0, 0, jnp.where(u_reg < c1, 1,
+                                     jnp.where(u_reg < c2, 2, 3))
+        ).astype(i32)
+
+        # flash crash: drop phase, then a recovery tail starting on the
+        # bar AFTER the last drop bar
+        crash_start = (
+            (crash_left == 0) & (recov_left == 0) & (u_crash < p_crash)
+        )
+        crash_left = jnp.where(crash_start, crash_len, crash_left)
+        in_crash = crash_left > 0
+        crash_left_next = jnp.maximum(crash_left - in_crash.astype(i32), 0)
+        recov_left = jnp.where(
+            in_crash & (crash_left_next == 0), recovery_len, recov_left
+        )
+        in_recov = ~in_crash & (recov_left > 0)
+        recov_left_next = jnp.where(in_recov, recov_left - 1, recov_left)
+
+        # liquidity drought window
+        drought_start = (drought_left == 0) & (u_drought < p_drought)
+        drought_left = jnp.where(drought_start, drought_len, drought_left)
+        in_drought = drought_left > 0
+        drought_left_next = jnp.maximum(
+            drought_left - in_drought.astype(i32), 0
+        )
+
+        vol_t = vol[regime] * jnp.where(in_drought, drought_vol, 1.0)
+        overlay_ret = (
+            jnp.where(in_crash, -crash_drop, 0.0)
+            + jnp.where(in_recov, recov_gain, 0.0)
+        )
+        ret = drift[regime] + vol_t * z_eps + overlay_ret  # (A,)
+
+        gap_evt = (u_gap < p_gap) | is_monday
+        gsz = jnp.where(is_monday, weekend_gap_size, gap_size)
+        gap = jnp.where(gap_evt, z_gap * gsz, 0.0)  # (A,)
+
+        open_ = jnp.exp(logp + gap)
+        logp = logp + gap + ret
+        close = jnp.exp(logp)
+        hi = jnp.maximum(open_, close) * jnp.exp(
+            hl_range * vol_t * jnp.abs(z_hi)
+        )
+        lo = jnp.minimum(open_, close) * jnp.exp(
+            -hl_range * vol_t * jnp.abs(z_lo)
+        )
+
+        spread_t = (
+            spread[regime]
+            * jnp.where(in_drought, drought_spread, 1.0)
+            * jnp.where(in_crash, crash_spread, 1.0)
+        )
+        slip_t = 1.0 + 0.5 * (spread_t - 1.0)
+
+        flags = (
+            jnp.where((regime == TREND_UP) | (regime == TREND_DOWN),
+                      FLAG_TREND, 0)
+            | jnp.where(in_drought, FLAG_DROUGHT, 0)
+            | jnp.where(in_crash, FLAG_CRASH, 0)
+            | jnp.where(gap_evt, FLAG_GAP, 0)
+            | jnp.where(regime == HIGHVOL, FLAG_HIGHVOL, 0)
+        ).astype(i32)
+
+        out = (open_, hi, lo, close, spread_t, slip_t, flags, regime)
+        carry = (regime, logp, crash_left_next, recov_left_next,
+                 drought_left_next)
+        return carry, out
+
+    init = (
+        jnp.asarray(p.regime0, i32),
+        jnp.log(s0),
+        jnp.zeros((), i32),
+        jnp.zeros((), i32),
+        jnp.zeros((), i32),
+    )
+    xs = (
+        shocks.regime_u, eps, shocks.gap_z, shocks.hi_z, shocks.lo_z,
+        shocks.crash_u, shocks.gap_u, shocks.drought_u, monday,
+    )
+    _, (o, h, l, c, sp, sl, flags, regime) = jax.lax.scan(step, init, xs)
+    return ScenPaths(
+        open=o, high=h, low=l, close=c,
+        spread_mult=sp, slip_mult=sl, flags=flags, regime=regime,
+    )
+
+
+_paths_jit = jax.jit(paths_from_shocks)
+
+
+def generate(
+    p: ScenarioParams,
+    key,
+    n_bars: int,
+    n_assets: int = 1,
+    monday_open: Optional[Any] = None,
+) -> ScenPaths:
+    """Draw shocks and run the jitted transform — the whole generation
+    is one compiled dispatch per (n_bars, n_assets) shape."""
+    if int(n_bars) < 2:
+        raise ValueError(f"scengen needs n_bars >= 2, got {n_bars}")
+    if int(n_assets) < 1:
+        raise ValueError(f"scengen needs n_assets >= 1, got {n_assets}")
+    if not (0.0 <= float(np.asarray(p.corr)) < 1.0):
+        raise ValueError(f"corr must be in [0, 1), got {p.corr!r}")
+    shocks = draw_shocks(key, int(n_bars), int(n_assets))
+    if monday_open is None:
+        monday_open = jnp.zeros((int(n_bars),), bool)
+    return _paths_jit(shocks, p, jnp.asarray(monday_open, bool))

@@ -414,6 +414,13 @@ def crosscheck_lob_episode(
     cfg = env.cfg
     if cfg.venue != "lob":
         raise ValueError("crosscheck_lob_episode requires venue=lob")
+    if cfg.lob_flow_from_scengen:
+        raise ValueError(
+            "crosscheck_lob_episode regenerates flow from the STATIC "
+            "scenario preset; feed=scengen derives per-bar FlowParams "
+            "from the tape's scen_flags, which the oracle replay does "
+            "not model — run the crosscheck on a replay feed"
+        )
     if cfg.enforce_margin_closeout:
         raise ValueError(
             "crosscheck_lob_episode does not model venue-forced "

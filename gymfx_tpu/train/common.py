@@ -55,26 +55,50 @@ def build_train_eval_envs(config: Dict[str, Any]) -> Tuple[Any, Optional[Any]]:
 
     eval_file = config.get("eval_data_file")
     split = config.get("eval_split")
+    feed = str(config.get("feed") or "replay").lower()
     if eval_file and split:
         raise ValueError("set either eval_data_file or eval_split, not both")
     if eval_file:
         eval_config = dict(config)
         eval_config["input_data_file"] = str(eval_file)
+        if feed == "scengen":
+            # train-on-synthetic / eval-on-real: the named eval file is
+            # by definition a replayed tape
+            eval_config["feed"] = "replay"
         return Environment(config), Environment(eval_config)
     if split:
-        from gymfx_tpu.data.feed import MarketDataset, load_dataframe
-
         frac = float(split)
         if not 0.0 < frac < 1.0:
             raise ValueError(f"eval_split must be in (0, 1), got {split!r}")
+        min_bars = int(config.get("window_size", 32)) + 2
+
+        def check(cut: int, n_all: int) -> None:
+            if cut < min_bars or n_all - cut < min_bars:
+                raise ValueError(
+                    f"eval_split={frac} leaves too few bars (train {cut}, "
+                    f"eval {n_all - cut}; both need >= {min_bars})"
+                )
+
+        if feed == "scengen":
+            # generate ONCE, then split chronologically — the same
+            # no-leakage cut as the replay path, and both halves come
+            # from one seeded tape (regenerating per half would desync
+            # the overlay processes at the cut)
+            from gymfx_tpu.scengen.feed import ScenGenDataset
+
+            full = ScenGenDataset(config)
+            n_all = len(full)
+            cut = n_all - int(n_all * frac)
+            check(cut, n_all)
+            return (
+                Environment(config, dataset=full.sliced(slice(0, cut))),
+                Environment(config, dataset=full.sliced(slice(cut, None))),
+            )
+        from gymfx_tpu.data.feed import MarketDataset, load_dataframe
+
         df = load_dataframe(config)
         cut = len(df) - int(len(df) * frac)
-        min_bars = int(config.get("window_size", 32)) + 2
-        if cut < min_bars or len(df) - cut < min_bars:
-            raise ValueError(
-                f"eval_split={frac} leaves too few bars (train {cut}, "
-                f"eval {len(df) - cut}; both need >= {min_bars})"
-            )
+        check(cut, len(df))
         train_env = Environment(
             config, dataset=MarketDataset(df.iloc[:cut], config)
         )

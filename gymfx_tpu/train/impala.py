@@ -547,7 +547,7 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     )
 
     profile = parse_fault_profile(config.get("fault_profile"))
-    if profile["nan_bars"] or profile["inf_bars"]:
+    if profile["nan_bars"] or profile["inf_bars"] or profile.get("scengen"):
         env.data = apply_fault_profile_to_market_data(env.data, profile)
     icfg = impala_config_from(config)
     from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis

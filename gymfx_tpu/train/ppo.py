@@ -764,7 +764,7 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     )
 
     profile = parse_fault_profile(config.get("fault_profile"))
-    if profile["nan_bars"] or profile["inf_bars"]:
+    if profile["nan_bars"] or profile["inf_bars"] or profile.get("scengen"):
         env.data = apply_fault_profile_to_market_data(env.data, profile)
     from gymfx_tpu.train.common import resolve_minibatch_scheme
 

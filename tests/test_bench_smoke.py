@@ -114,6 +114,34 @@ def test_lob_bench_quick_emits_schema_valid_fills_row():
     assert payload["value"] == payload["depth_sweep"]["24"]["fills_per_sec"]
 
 
+def test_scengen_bench_quick_emits_schema_valid_bars_row():
+    """``bench.py --scengen --quick`` (PR 9): the final stdout line is a
+    schema-valid ``scengen_bars_per_sec`` record from a real generation
+    sweep over two presets — the row docs/scenarios.md quotes."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gymfx_jax_cache")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--scengen", "--quick"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    payload = json.loads(lines[-1])
+    problems = validate_record(payload)
+    assert not problems, (problems, payload)
+    assert payload["metric"] == "scengen_bars_per_sec"
+    assert payload["value"] > 0
+    assert payload["n_bars"] == 4096 and payload["n_assets"] == 1  # --quick
+    # headline row == the first swept preset's entry
+    assert payload["preset"] == "regime_mix"
+    assert set(payload["preset_sweep"]) == {"regime_mix", "flash_crash"}
+    for row in payload["preset_sweep"].values():
+        assert row["bars_per_sec"] > 0 and row["gen_ms"] > 0
+    assert payload["value"] == \
+        payload["preset_sweep"]["regime_mix"]["bars_per_sec"]
+
+
 @pytest.mark.slow
 def test_lob_bench_full_depth_sweep_at_1024_books():
     """The acceptance-criteria shape: a >=1024-book vmapped sweep still

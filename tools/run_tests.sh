@@ -16,9 +16,11 @@
 # suspect a shared/stale JAX_COMPILATION_CACHE_DIR leaking in from
 # the environment before blaming the test that happened to be running.
 #
-# After the suite: a telemetry smoke (ephemeral /metrics endpoint,
-# one scrape, assert non-empty — docs/observability.md) and a per-run
-# summary row appended to PROGRESS.jsonl through the JSONL sink.
+# After the suite: the scenario robustness gate in quick mode (three
+# scengen presets + the serving-fallback leg, schema-pinned report —
+# docs/scenarios.md), then a telemetry smoke (ephemeral /metrics
+# endpoint, one scrape, assert non-empty — docs/observability.md) and a
+# per-run summary row appended to PROGRESS.jsonl through the JSONL sink.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -27,6 +29,13 @@ rc=0
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@" || rc=$?
 wall=$(( $(date +%s) - start ))
+
+# scenario robustness gate, quick matrix (report to stdout; non-zero on
+# any failed preset or serving leg)
+gate_rc=0
+env JAX_PLATFORMS=cpu python tools/scenario_gate.py --quick \
+    > /dev/null || gate_rc=$?
+echo "scenario gate (quick): rc=$gate_rc"
 
 # telemetry smoke + PROGRESS row (registry/http/sink are jax-free:
 # this is sub-second and runs even when the suite failed, so the row
@@ -69,5 +78,8 @@ EOF
 
 if [ "$rc" -ne 0 ]; then
     exit "$rc"
+fi
+if [ "$gate_rc" -ne 0 ]; then
+    exit "$gate_rc"
 fi
 exit "$smoke_rc"

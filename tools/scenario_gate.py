@@ -1,0 +1,344 @@
+#!/usr/bin/env python3
+"""Robustness gate over the generative scenario suite (docs/scenarios.md).
+
+Runs a driver policy end-to-end through the env on each scengen preset
+and checks the episode stays well-formed (finite equity stream, the
+preset's signature events actually present in the tape), then exercises
+the live serving path — engine ladder, order router, degraded-mode
+fallback — against a generated feed with one injected dispatch fault.
+Emits a single schema-pinned ``scenario_gate_report`` JSON document
+(``tools/scenario_gate_schema.json``):
+
+    python tools/scenario_gate.py --quick            # CI smoke (~3 presets)
+    python tools/scenario_gate.py --out report.json  # full matrix
+
+Exit status is non-zero when any scenario or the serving leg fails, so
+the gate drops into CI as-is.  ``validate_report`` is imported by
+``tests/test_scengen.py``, keeping the schema and this emitter from
+drifting apart silently.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+from typing import Any, Dict, List
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SCHEMA_PATH = Path(__file__).resolve().parent / "scenario_gate_schema.json"
+
+QUICK_PRESETS = ("regime_mix", "flash_crash", "liquidity_drought")
+
+# per-preset signature events the generated tape must actually contain —
+# a preset whose hazard never fires is a silent gate bypass
+_EXPECTED_FLAGS = {
+    "flash_crash": ("crash",),
+    "liquidity_drought": ("drought",),
+    "gap_open": ("gap",),
+    "multi_asset_stress": ("crash", "drought", "gap"),
+}
+
+
+def load_schema() -> Dict[str, Any]:
+    with open(SCHEMA_PATH, encoding="utf-8") as fh:
+        schema = json.load(fh)
+    schema.pop("_comment", None)
+    return schema
+
+
+def _finite(value: Any) -> bool:
+    return (
+        isinstance(value, (int, float))
+        and not isinstance(value, bool)
+        and math.isfinite(float(value))
+    )
+
+
+def validate_report(report: Dict[str, Any],
+                    schema: Dict[str, Any] | None = None) -> List[str]:
+    """Return a list of contract violations (empty = report conforms)."""
+    if schema is None:
+        schema = load_schema()
+    problems: List[str] = []
+    if not isinstance(report, dict):
+        return [f"report is not a JSON object: {type(report).__name__}"]
+    if report.get("kind") != schema["kind"]:
+        problems.append(
+            f"kind must be {schema['kind']!r}, got {report.get('kind')!r}"
+        )
+    for key in schema["required"]:
+        if key not in report:
+            problems.append(f"missing required key {key!r}")
+    scenarios = report.get("scenarios")
+    if not isinstance(scenarios, dict) or not scenarios:
+        problems.append("'scenarios' must be a non-empty object")
+        scenarios = {}
+    for preset, row in scenarios.items():
+        if not isinstance(row, dict):
+            problems.append(f"scenario {preset!r} is not an object")
+            continue
+        for key in schema["scenario_required"]:
+            if key not in row:
+                problems.append(f"scenario {preset!r}: missing key {key!r}")
+        for key in schema["scenario_numeric"]:
+            if key in row and not _finite(row[key]):
+                problems.append(
+                    f"scenario {preset!r}: key {key!r} must be a finite "
+                    f"number, got {row[key]!r}"
+                )
+        for key in schema["scenario_integer"]:
+            if key in row and not (
+                isinstance(row[key], int) and not isinstance(row[key], bool)
+            ):
+                problems.append(
+                    f"scenario {preset!r}: key {key!r} must be an integer, "
+                    f"got {row[key]!r}"
+                )
+        if "flag_counts" in row and not isinstance(row["flag_counts"], dict):
+            problems.append(
+                f"scenario {preset!r}: 'flag_counts' must be an object"
+            )
+    serving = report.get("serving")
+    if not isinstance(serving, dict):
+        problems.append("'serving' must be an object")
+    else:
+        for key in schema["serving_required"]:
+            if key not in serving:
+                problems.append(f"serving: missing key {key!r}")
+        for key in schema["serving_integer"]:
+            if key in serving and not (
+                isinstance(serving[key], int)
+                and not isinstance(serving[key], bool)
+            ):
+                problems.append(
+                    f"serving: key {key!r} must be an integer, "
+                    f"got {serving[key]!r}"
+                )
+    return problems
+
+
+class _StubTransport:
+    """Minimal recording transport for the serving leg — the venue
+    payload shape is asserted by tests/test_live_serve.py; the gate only
+    needs a live stack that never touches the network."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, method, url, headers, body):
+        self.calls.append((method, url))
+        if method == "GET" and "/openPositions" in url:
+            return 200, b'{"positions": []}'
+        return 200, b"{}"
+
+
+def _scenario_row(preset: str, n_bars: int, seed: int, steps: int | None,
+                  window: int) -> Dict[str, Any]:
+    import jax
+    import numpy as np
+
+    from gymfx_tpu.core.rollout import buy_hold_driver, rollout
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.scengen.params import (
+        FLAG_CRASH,
+        FLAG_DROUGHT,
+        FLAG_GAP,
+        FLAG_HIGHVOL,
+        FLAG_TREND,
+    )
+
+    env = Environment({
+        "feed": "scengen",
+        "scengen_preset": preset,
+        "scengen_bars": n_bars,
+        "scengen_seed": seed,
+        "window_size": window,
+        "quiet_mode": True,
+    })
+    # same step count for every preset so the episode scan compiles once
+    n_steps = steps if steps is not None else env.cfg.n_bars - window - 2
+    _state, outputs = rollout(
+        env.cfg, env.params, env.data, buy_hold_driver(), n_steps,
+        jax.random.PRNGKey(seed),
+    )
+    equity = np.asarray(outputs["equity_delta"], np.float64) \
+        + float(env.params.initial_cash)
+    finite = bool(np.all(np.isfinite(equity)))
+    peak = np.maximum.accumulate(np.maximum(equity, 1e-9))
+    max_dd = float(np.max(1.0 - equity / peak)) if finite else float("nan")
+
+    flags = np.asarray(env.dataset.scen_flags)
+    flag_counts = {
+        "trend": int(np.sum(flags & FLAG_TREND != 0)),
+        "drought": int(np.sum(flags & FLAG_DROUGHT != 0)),
+        "crash": int(np.sum(flags & FLAG_CRASH != 0)),
+        "gap": int(np.sum(flags & FLAG_GAP != 0)),
+        "highvol": int(np.sum(flags & FLAG_HIGHVOL != 0)),
+    }
+    spread_max = float(
+        env.dataset.dataframe["event_spread_stress_multiplier"].max()
+    )
+    expectations_met = all(
+        flag_counts[name] > 0 for name in _EXPECTED_FLAGS.get(preset, ())
+    )
+    return {
+        "preset": preset,
+        "bars": int(env.cfg.n_bars),
+        "steps": int(n_steps),
+        "finite": finite,
+        "final_equity": float(equity[-1]),
+        "min_equity": float(np.min(equity)),
+        "max_drawdown": max_dd,
+        "flag_counts": flag_counts,
+        "spread_mult_max": spread_max,
+        "expectations_met": expectations_met,
+        "passed": finite and expectations_met,
+    }
+
+
+def _serving_row(preset: str, n_bars: int, seed: int,
+                 ticks: int) -> Dict[str, Any]:
+    """The live-path leg: generated feed -> warm engine ladder ->
+    TargetOrderRouter, with ONE injected dispatch fault mid-stream; the
+    configured ``serve_fallback`` must absorb it (tagged decision, no
+    crash) and every other tick must serve without a late compile."""
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.live.oanda import (
+        OandaLiveBroker,
+        PolicyDecisionService,
+        TargetOrderRouter,
+    )
+    from gymfx_tpu.resilience.faults import FlakyEngine
+    from gymfx_tpu.serve.engine import engine_from_config
+
+    env = Environment({
+        "feed": "scengen",
+        "scengen_preset": preset,
+        "scengen_bars": n_bars,
+        "scengen_seed": seed,
+        "window_size": 16,
+        "quiet_mode": True,
+    })
+    cfg = dict(env.config)
+    cfg.update(serve_buckets=[1], serve_fallback="hold")
+    transport = _StubTransport()
+    broker = OandaLiveBroker("gate-token", "gate-acct", transport=transport)
+    router = TargetOrderRouter(broker, str(cfg.get("instrument", "EUR_USD")))
+    bundle = engine_from_config(cfg, env=env)
+    svc = PolicyDecisionService(cfg, router, bundle=bundle, units=1000)
+    # fault exactly one dispatch mid-stream (tick index 2)
+    plan = ["ok", "ok", "exc"] + ["ok"] * max(0, ticks - 3)
+    svc.engine = FlakyEngine(svc.engine, plan=plan)
+
+    closes = env.dataset.dataframe["CLOSE"].to_numpy()[:ticks]
+    fallback_tagged = False
+    for i, close in enumerate(closes):
+        svc.decide_and_route(float(close))
+        rec = svc.decision_records[-1]
+        if i == 2:
+            fallback_tagged = rec.source == "fallback"
+    late = int(svc.engine.late_compiles)
+    row = {
+        "preset": preset,
+        "ticks": int(len(closes)),
+        "decisions": int(svc.decisions),
+        "fallback_count": int(svc.fallback_count),
+        "late_compiles": late,
+        "fallback_tagged": bool(fallback_tagged),
+    }
+    row["passed"] = (
+        row["decisions"] == row["ticks"]
+        and row["fallback_count"] == 1
+        and row["fallback_tagged"]
+        and late == 0
+    )
+    return row
+
+
+def run_gate(presets=None, n_bars: int = 2048, seed: int = 0,
+             quick: bool = False, serving_ticks: int = 8) -> Dict[str, Any]:
+    from gymfx_tpu.scengen.params import preset_names
+
+    if quick:
+        presets = list(presets or QUICK_PRESETS)
+        n_bars = min(n_bars, 384)
+        serving_ticks = min(serving_ticks, 6)
+    presets = list(presets or preset_names())
+    window = 16
+    steps = n_bars - window - 2
+    scenarios = {
+        p: _scenario_row(p, n_bars, seed, steps, window) for p in presets
+    }
+    serving = _serving_row(presets[0], max(64, min(n_bars, 256)), seed,
+                           serving_ticks)
+    report = {
+        "kind": "scenario_gate_report",
+        "schema_version": 1,
+        "quick": bool(quick),
+        "seed": int(seed),
+        "n_bars": int(n_bars),
+        "presets": presets,
+        "scenarios": scenarios,
+        "serving": serving,
+        "passed": (
+            all(row["passed"] for row in scenarios.values())
+            and serving["passed"]
+        ),
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--quick", action="store_true",
+        help=f"CI smoke: presets {QUICK_PRESETS}, short tapes",
+    )
+    ap.add_argument(
+        "--presets", type=str, default=None,
+        help="comma-separated preset subset (default: the full registry)",
+    )
+    ap.add_argument("--bars", type=int, default=2048)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--out", type=str, default=None,
+        help="write the report to this path (always printed to stdout)",
+    )
+    args = ap.parse_args(argv)
+    presets = (
+        [p for p in args.presets.split(",") if p.strip()]
+        if args.presets else None
+    )
+    report = run_gate(
+        presets=presets, n_bars=args.bars, seed=args.seed, quick=args.quick
+    )
+    problems = validate_report(report)
+    if problems:  # emitter bug — fail loudly, never ship a bad report
+        for p in problems:
+            print(f"SCENARIO GATE SCHEMA VIOLATION: {p}", file=sys.stderr)
+        return 2
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        Path(args.out).write_text(text + "\n", encoding="utf-8")
+    if not report["passed"]:
+        failed = [
+            p for p, row in report["scenarios"].items() if not row["passed"]
+        ]
+        if not report["serving"]["passed"]:
+            failed.append("serving")
+        print(f"scenario gate FAILED: {failed}", file=sys.stderr)
+        return 1
+    print(
+        f"scenario gate OK ({len(report['scenarios'])} presets + serving)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
