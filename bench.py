@@ -10,7 +10,6 @@ local accelerator.  vs_baseline compares against the target of
 Usage: python bench.py [--n_envs N] [--horizon T] [--iters K] [--quick]
 """
 import argparse
-import json
 import sys
 
 # Honor JAX_PLATFORMS=cpu even where sitecustomize force-registers a
@@ -55,6 +54,21 @@ def lob_main(args) -> None:
     fp = scenario_flow_params("lob_calm")
     key = jax.random.PRNGKey(0)
 
+    # r10: route the sweep through the pallas matcher (ops/lob_match.py)
+    # instead of the XLA oracle scan — "on" picks native pallas on TPU
+    # and interpret elsewhere; exact int32 parity is pinned by
+    # tests/test_lob_match_kernel.py so both paths count the same fills
+    match_kernel = args.lob_match_kernel
+    if match_kernel != "off":
+        from gymfx_tpu.ops.lob_match import fused_process_stream
+
+        interp = True if match_kernel == "interpret" else None
+
+        def _stream(book, m):
+            return fused_process_stream(book, m, interpret=interp)
+    else:
+        _stream = process_stream
+
     sweep = {}
     for depth in depths:
         msgs = jax.block_until_ready(
@@ -64,7 +78,7 @@ def lob_main(args) -> None:
         @jax.jit
         def run(ms, depth=depth):
             return jax.vmap(
-                lambda m: process_stream(empty_book(depth, queue_slots), m)
+                lambda m: _stream(empty_book(depth, queue_slots), m)
             )(ms)
 
         book, fills = run(msgs)  # compile + warmup
@@ -85,26 +99,33 @@ def lob_main(args) -> None:
 
     headline_depth = 24 if "24" in sweep else depths[0]
     head = sweep[str(headline_depth)]
-    print(
-        json.dumps(
-            {
-                "metric": "lob_fills_per_sec",
-                "value": head["fills_per_sec"],
-                "unit": (
-                    "fills/sec/chip (vmapped LOB matching, "
-                    f"depth={headline_depth}x{queue_slots} slots, "
-                    "lob_calm flow mix)"
-                ),
-                "fills_per_sec_per_chip": head["fills_per_sec"],
-                "msgs_per_sec": head["msgs_per_sec"],
-                "match_ms": head["match_ms"],
-                "books": books,
-                "depth_levels": headline_depth,
-                "queue_slots": queue_slots,
-                "messages_per_stream": messages,
-                "depth_sweep": sweep,
-            }
-        )
+    from gymfx_tpu.bench_util import emit_bench_record
+
+    # shared row helper (r10): the analytic-MFU key block rides on every
+    # bench row — null here (integer matching has no dense-GEMM FLOP
+    # model) but the KEY SET matches the trainer rows, so dashboards
+    # parse one schema
+    emit_bench_record(
+        {
+            "metric": "lob_fills_per_sec",
+            "value": head["fills_per_sec"],
+            "unit": (
+                "fills/sec/chip (vmapped LOB matching, "
+                f"depth={headline_depth}x{queue_slots} slots, "
+                "lob_calm flow mix)"
+            ),
+            "fills_per_sec_per_chip": head["fills_per_sec"],
+            "msgs_per_sec": head["msgs_per_sec"],
+            "match_ms": head["match_ms"],
+            "books": books,
+            "depth_levels": headline_depth,
+            "queue_slots": queue_slots,
+            "messages_per_stream": messages,
+            "lob_match_kernel": match_kernel,
+            "depth_sweep": sweep,
+        },
+        step_time_s=head["match_ms"] / 1e3,
+        device=jax.devices()[0],
     )
 
 
@@ -153,24 +174,26 @@ def scengen_main(args) -> None:
         }
 
     head = sweep[presets[0]]
-    print(
-        json.dumps(
-            {
-                "metric": "scengen_bars_per_sec",
-                "value": head["bars_per_sec"],
-                "unit": (
-                    "generated bars/sec/chip (scanned regime/overlay "
-                    f"transform, {n_assets} asset(s), "
-                    f"preset={presets[0]})"
-                ),
-                "bars_per_sec_per_chip": head["bars_per_sec"],
-                "gen_ms": head["gen_ms"],
-                "n_bars": n_bars,
-                "n_assets": n_assets,
-                "preset": presets[0],
-                "preset_sweep": sweep,
-            }
-        )
+    from gymfx_tpu.bench_util import emit_bench_record
+
+    emit_bench_record(
+        {
+            "metric": "scengen_bars_per_sec",
+            "value": head["bars_per_sec"],
+            "unit": (
+                "generated bars/sec/chip (scanned regime/overlay "
+                f"transform, {n_assets} asset(s), "
+                f"preset={presets[0]})"
+            ),
+            "bars_per_sec_per_chip": head["bars_per_sec"],
+            "gen_ms": head["gen_ms"],
+            "n_bars": n_bars,
+            "n_assets": n_assets,
+            "preset": presets[0],
+            "preset_sweep": sweep,
+        },
+        step_time_s=head["gen_ms"] / 1e3,
+        device=jax.devices()[0],
     )
 
 
@@ -188,6 +211,14 @@ def main() -> None:
     )
     ap.add_argument("--quick", action="store_true", help="small shapes (CI)")
     ap.add_argument(
+        "--rollout_env_kernel", choices=["off", "on", "interpret"],
+        default="on",
+        help="fused env-dynamics pallas kernels in the rollout scan "
+             "(ops/env_dynamics.py; 'on' falls back to plain XLA "
+             "off-TPU, 'interpret' runs the kernels in pallas "
+             "interpret mode on any backend — the CI parity path)",
+    )
+    ap.add_argument(
         "--trace", type=str, default=None, metavar="DIR",
         help="capture a jax.profiler trace of the timed loop into DIR "
              "(view with tensorboard or xprof)",
@@ -200,6 +231,12 @@ def main() -> None:
     )
     ap.add_argument("--books", type=int, default=1024)
     ap.add_argument("--messages", type=int, default=256)
+    ap.add_argument(
+        "--lob_match_kernel", choices=["off", "on", "interpret"],
+        default="off",
+        help="route the --lob sweep through the pallas matching kernel "
+             "(ops/lob_match.py) instead of the XLA oracle scan",
+    )
     ap.add_argument(
         "--depths", type=str, default="8,16,24,48",
         help="comma-separated book depths for the --lob sweep",
@@ -264,6 +301,11 @@ def main() -> None:
         # buffer's HBM write+read traffic (docs/performance.md)
         rollout_obs_kernel="on",
         rollout_collect_dtype="bfloat16",
+        # env-dynamics hot path (r10): the reward/broker scan's
+        # fill/bracket and mark/reward passes as fused pallas kernels
+        # bracketing the strategy kernel (bitwise vs the XLA oracle —
+        # tests/test_env_dynamics_kernel.py); "on" falls back off-TPU
+        rollout_env_kernel=args.rollout_env_kernel,
     )
     env = Environment(config)
     trainer = PPOTrainer(env, ppo_config_from(config))
@@ -284,12 +326,17 @@ def main() -> None:
     # phase attribution: rollout vs update halves timed as donated-carry
     # sub-programs off the same phase methods the fused step composes
     # (bench_util.measure_phase_split) — proves where the cycle goes
-    rollout_ms = update_ms = None
+    rollout_ms = update_ms = update_gemm_frac = None
     split = measure_phase_split(trainer, state, args.iters)
     if split is not None:
-        rollout_s, update_s, state = split
+        rollout_s, update_s, state, update_flops = split
         rollout_ms = rollout_s / args.iters * 1e3
         update_ms = update_s / args.iters * 1e3
+        # share of the whole step's XLA cost-model FLOPs spent in the
+        # update phase (the GEMM chain) — the ceiling on what the r10
+        # rollout/update overlap can hide
+        if update_flops and step_flops:
+            update_gemm_frac = min(1.0, update_flops / step_flops)
 
     if args.trace:
         # one traced fused step on the already-compiled executable
@@ -301,6 +348,7 @@ def main() -> None:
     K = max(1, args.supersteps)
     baseline_per_chip = 1_000_000 / 8  # BASELINE.json: 1M steps/s on v5p-8
     steps_per_iter = args.n_envs * args.horizon
+    overlap_ms_saved = None
     if K > 1:
         # same number of timed dispatches, each covering K train steps
         dtK, dispatch_flops, state, _ = measure_train_many(
@@ -312,6 +360,21 @@ def main() -> None:
         # fraction of per-step wall time that was host dispatch/sync
         # overhead, eliminated by fusing K steps into one dispatch
         overhead = max(0.0, 1.0 - per_step / per_step_single)
+
+        # r10 overlap driver: the same K-step superstep with iteration
+        # i's rollout issued alongside iteration i-1's update GEMMs
+        # (train/common.make_train_many_overlapped — opt-in one-update-
+        # stale rollout params).  Reported as per-train-step ms saved vs
+        # the sequential superstep; null at K=1 (no overlap body runs)
+        from gymfx_tpu.train.ppo import PPOTrainer as _PPOTrainer
+
+        trainer_ovl = _PPOTrainer(
+            env, ppo_config_from(dict(config, superstep_overlap=True))
+        )
+        dtO, _oflops, _ostate, _ = measure_train_many(
+            trainer_ovl, trainer_ovl.init_state(0), args.iters, K
+        )
+        overlap_ms_saved = (per_step - dtO / (args.iters * K)) * 1e3
     else:
         steps_per_sec = steps_per_iter / per_step_single
         util = mfu(step_flops, args.iters, dt1, jax.devices()[0])
@@ -320,7 +383,7 @@ def main() -> None:
     # analytic cross-check of the XLA cost-model MFU: closed-form FLOPs
     # from the policy's parameter shapes (telemetry/mfu.py), plus device
     # memory accounting — keys are always present, null off-TPU
-    from gymfx_tpu.telemetry.mfu import analytic_train_step_flops, mfu_report
+    from gymfx_tpu.telemetry.mfu import analytic_train_step_flops
 
     analytic = analytic_train_step_flops(
         state.params,
@@ -329,41 +392,52 @@ def main() -> None:
         update_epochs=int(config["ppo_epochs"]),
     )
     per_step_s = per_step if K > 1 else per_step_single
-    report = mfu_report(analytic, per_step_s, jax.devices()[0])
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_env_steps_per_sec_per_chip",
-                "value": round(steps_per_sec, 1),
-                "unit": "env steps/sec/chip (PPO MLP bf16 policy, fused "
-                        "rollout+update, env-permuted minibatches)",
-                "vs_baseline": round(steps_per_sec / baseline_per_chip, 3),
-                # XLA cost-model FLOPs / public peak bf16 chip FLOPs
-                # (gymfx_tpu/bench_util.py); null off-TPU
-                "mfu": round(util, 5) if util is not None else None,
-                "supersteps": K,
-                # per-train-step host overhead removed by the superstep
-                # driver: 1 - (superstep per-step time / single-dispatch
-                # per-step time); null at K=1 (nothing to compare)
-                "dispatch_overhead_frac": (
-                    round(overhead, 4) if overhead is not None else None
-                ),
-                "per_step_ms_single_dispatch": round(per_step_single * 1e3, 3),
-                # rollout/update phase attribution (donated-carry
-                # sub-programs; sums slightly above the fused step —
-                # read them as a ratio, not an absolute)
-                "rollout_ms": (
-                    round(rollout_ms, 3) if rollout_ms is not None else None
-                ),
-                "update_ms": (
-                    round(update_ms, 3) if update_ms is not None else None
-                ),
-                # analytic FLOP model + memory accounting
-                # (gymfx_tpu/telemetry/mfu.py); null where the backend
-                # cannot say (CPU peak FLOPs / memory_stats)
-                **report,
-            }
-        )
+    from gymfx_tpu.bench_util import emit_bench_record
+
+    emit_bench_record(
+        {
+            "metric": "ppo_env_steps_per_sec_per_chip",
+            "value": round(steps_per_sec, 1),
+            "unit": "env steps/sec/chip (PPO MLP bf16 policy, fused "
+                    "rollout+update, env-permuted minibatches)",
+            "vs_baseline": round(steps_per_sec / baseline_per_chip, 3),
+            # XLA cost-model FLOPs / public peak bf16 chip FLOPs
+            # (gymfx_tpu/bench_util.py); null off-TPU
+            "mfu": round(util, 5) if util is not None else None,
+            "supersteps": K,
+            # per-train-step host overhead removed by the superstep
+            # driver: 1 - (superstep per-step time / single-dispatch
+            # per-step time); null at K=1 (nothing to compare)
+            "dispatch_overhead_frac": (
+                round(overhead, 4) if overhead is not None else None
+            ),
+            "per_step_ms_single_dispatch": round(per_step_single * 1e3, 3),
+            # rollout/update phase attribution (donated-carry
+            # sub-programs; sums slightly above the fused step —
+            # read them as a ratio, not an absolute)
+            "rollout_ms": (
+                round(rollout_ms, 3) if rollout_ms is not None else None
+            ),
+            "update_ms": (
+                round(update_ms, 3) if update_ms is not None else None
+            ),
+            # r10 overlap accounting: per-train-step ms the overlapped
+            # superstep saves vs the sequential one (null at K=1), and
+            # the update phase's share of whole-step FLOPs — the
+            # overlap's theoretical ceiling
+            "overlap_ms_saved": (
+                round(overlap_ms_saved, 3)
+                if overlap_ms_saved is not None else None
+            ),
+            "update_gemm_frac": (
+                round(update_gemm_frac, 4)
+                if update_gemm_frac is not None else None
+            ),
+            "rollout_env_kernel": args.rollout_env_kernel,
+        },
+        analytic_flops=analytic,
+        step_time_s=per_step_s,
+        device=jax.devices()[0],
     )
 
 

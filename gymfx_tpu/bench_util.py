@@ -117,8 +117,11 @@ def measure_phase_split(trainer: Any, state: Any, iters: int):
     The sum slightly overstates the fused step (two dispatches, a
     host sync between phases, and no cross-phase fusion), so callers
     should report the *fraction* against the fused per-step time.
-    Returns ``(rollout_seconds, update_seconds, final_state)``, or
-    ``None`` when the trainer has no phase methods.
+    Returns ``(rollout_seconds, update_seconds, final_state,
+    update_flops)`` — ``update_flops`` is the XLA cost-model FLOPs of
+    the compiled update phase (the GEMM chain), None where the backend
+    hides cost analysis — or ``None`` when the trainer has no phase
+    methods.
     """
     import jax
 
@@ -132,7 +135,7 @@ def measure_phase_split(trainer: Any, state: Any, iters: int):
     if r_step is None:
         r_step = r_jit
     inter, rollout_out = r_step(state)
-    u_step, _ = compile_with_flops(u_jit, inter, rollout_out)
+    u_step, u_flops = compile_with_flops(u_jit, inter, rollout_out)
     if u_step is None:
         u_step = u_jit
     state, _ = u_step(inter, rollout_out)  # warmup both phases
@@ -148,7 +151,30 @@ def measure_phase_split(trainer: Any, state: Any, iters: int):
         jax.block_until_ready(state)
         update_s += time.perf_counter() - t1
         rollout_s += t1 - t0
-    return rollout_s, update_s, state
+    return rollout_s, update_s, state, u_flops
+
+
+def emit_bench_record(
+    record: dict,
+    *,
+    analytic_flops: Optional[float] = None,
+    step_time_s: Optional[float] = None,
+    device: Any = None,
+) -> dict:
+    """ONE row-construction path for every benchmark emitter (bench.py
+    ppo/lob/scengen mains, tools/tpu_bench.py sweep rows): append the
+    telemetry/mfu.py analytic-MFU slice — analytic_flops_per_step /
+    hw_flops_peak / mfu_analytic / device_memory_bytes, every key
+    always present, null where the backend or workload cannot say
+    (CPU peak FLOPs; integer workloads with no FLOP model) — then
+    print the record as the single JSON contract line and return it."""
+    import json
+
+    from gymfx_tpu.telemetry.mfu import mfu_report
+
+    record.update(mfu_report(analytic_flops, step_time_s, device))
+    print(json.dumps(record), flush=True)
+    return record
 
 
 # Public per-chip peak dense bf16 FLOPs/sec (vendor-published specs).

@@ -97,7 +97,22 @@ def parse_args(argv=None):
         "--rollout_obs_kernel", choices=["off", "on", "interpret"]
     )
     parser.add_argument(
+        "--rollout_env_kernel", choices=["off", "on", "interpret"]
+    )
+    parser.add_argument(
+        "--lob_match_kernel", choices=["off", "on", "interpret"]
+    )
+    parser.add_argument(
         "--rollout_collect_dtype", choices=["float32", "bfloat16"]
+    )
+    parser.add_argument(
+        "--optimizer_state_dtype", choices=["float32", "bfloat16"]
+    )
+    parser.add_argument(
+        "--superstep_overlap", action="store_true", default=None
+    )
+    parser.add_argument(
+        "--ppo_update_remat", action="store_true", default=None
     )
 
     # serving flags (docs/serving.md); buckets as JSON, e.g. "[1,8,64]"

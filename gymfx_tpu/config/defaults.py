@@ -139,11 +139,37 @@ DEFAULT_VALUES = {
     # oracle), on = pallas on TPU / XLA fallback elsewhere, interpret =
     # pallas interpret mode anywhere (CPU parity tests)
     "rollout_obs_kernel": "off",
+    # fused env-dynamics kernel family (ops/env_dynamics.py): the bar
+    # venue's fill/bracket/financing chain and the mark/reward chain as
+    # two env-blocked pallas VMEM passes bracketing the strategy kernel.
+    # off = plain XLA (the bitwise oracle), on = pallas on TPU / XLA
+    # fallback elsewhere, interpret = pallas interpret mode anywhere
+    "rollout_env_kernel": "off",
+    # pallas LOB stream matching (ops/lob_match.py): sort-free ranked
+    # matcher with exact int32 parity vs lob/book.py; same mode contract
+    "lob_match_kernel": "off",
     # storage dtype for the COLLECTED trajectory obs (the widest rollout
     # buffers): bfloat16 halves trajectory write+read HBM traffic;
     # actions/log-probs/values always stay f32 so PPO ratio numerics
     # are untouched (quality-parity gate: docs/performance.md)
     "rollout_collect_dtype": "float32",  # float32 | bfloat16
+    # opt-in bf16 optimizer state: Adam's first moment (the largest
+    # optimizer buffer) stored in bfloat16; params and the second moment
+    # stay float32 (the master-weight rule).  Gated by a learning-parity
+    # smoke (tests/test_opt_state_dtype.py), off by default
+    "optimizer_state_dtype": "float32",  # float32 | bfloat16
+    # overlap superstep driver (train/common.make_train_many_overlapped):
+    # iteration i's rollout is issued against pre-update params while
+    # iteration i-1's update GEMMs execute, so the XLA scheduler can
+    # overlap the two phases.  Opt-in: rollouts see one-update-stale
+    # params and guard-quarantine env resets are dropped inside a
+    # dispatch (docs/performance.md, "MFU push")
+    "superstep_overlap": False,
+    # rematerialize the policy forward in the PPO loss (jax.remat): the
+    # update phase recomputes activations inside the backward GEMM chain
+    # instead of staging them through HBM — numerically identical,
+    # memory-traffic win on TPU
+    "ppo_update_remat": False,
     # live-path retry/backoff + circuit breaker (oanda_broker plugin)
     "live_retry_max_attempts": 4,
     "live_retry_base_delay": 0.25,

@@ -146,6 +146,18 @@ def step(
 
     st = state._replace(t=t_new, last_trade_cost=jnp.zeros_like(state.last_trade_cost))
 
+    # fused env-dynamics kernel dispatch (`rollout_env_kernel` knob,
+    # docs/performance.md "MFU push"): "on" routes the bar venue's
+    # fill/bracket/financing and mark/reward chains through the pallas
+    # env-blocked kernels on TPU (plain XLA elsewhere); "interpret"
+    # forces pallas interpret mode anywhere (CPU parity tests); "off"
+    # is plain XLA everywhere.  All three bitwise-identical by
+    # construction (ops/env_dynamics.py; tests/test_env_dynamics_kernel.py).
+    kernel_env = cfg.venue == "bar" and cfg.rollout_env_kernel != "off" and (
+        cfg.rollout_env_kernel == "interpret"
+        or jax.default_backend() == "tpu"
+    )
+
     if cfg.venue == "lob":
         # 1+2 (LOB venue): the pending order walks the seeded book at
         # the open and brackets resolve against actual prints along the
@@ -166,6 +178,19 @@ def step(
             st, o, h, l, c, t_new, cfg, params, scen_flags=scen
         )
         st = _select(advance, st_l, st)
+    elif kernel_env:
+        # 1+2+2b fused (kernel A, ops/env_dynamics.py): the same
+        # fill_pending -> check_brackets -> financing chain as below,
+        # packed into one env-blocked pallas VMEM pass
+        from gymfx_tpu.ops import env_dynamics
+
+        st = env_dynamics.fused_fill_brackets(
+            st, o, h, l, c,
+            data.rollover_accrual[t_new - r0]
+            if cfg.financing_enabled else None,
+            advance, cfg, params,
+            interpret=cfg.rollout_env_kernel == "interpret",
+        )
     else:
         # 1. pending order fills at the new bar's open (only when advancing)
         st_f = broker.fill_pending(st, o, params, cfg, h, l)
@@ -180,8 +205,9 @@ def step(
     #     multiply-add per step — the scan twin of the replay engine's
     #     apply_rollover (simulation/replay.py) and of the reference's
     #     FXRolloverInterestModule (reference
-    #     simulation_engines/nautilus_gym.py:276-290).
-    if cfg.financing_enabled:
+    #     simulation_engines/nautilus_gym.py:276-290).  (Folded into
+    #     kernel A on the fused path above.)
+    if cfg.financing_enabled and not kernel_env:
         accrual = st.pos * c * data.rollover_accrual[t_new - r0]
         st = st._replace(
             cash_delta=st.cash_delta + jnp.where(advance, accrual, 0.0)
@@ -213,8 +239,21 @@ def step(
         )
     # 4. mark equity at the close (advancing bars only; the warmup step
     #    re-marks bar 0, which is a no-op on an untouched ledger)
-    st_m = broker.mark_to_market(st, c, params)
-    st = _select(advance | (live & ~state.started), st_m, st)
+    if kernel_env:
+        # 4 + reward fused (kernel B): mark, drawdown and the reward
+        # carries in one VMEM pass.  The base reward is computed HERE —
+        # nothing between this mark and the reward block below reads or
+        # writes the equity deltas or reward carries, so the program is
+        # identical with the reward hoisted to the mark.
+        from gymfx_tpu.ops import env_dynamics
+
+        st, _kernel_base_reward = env_dynamics.fused_mark_reward(
+            st, c, advance | (live & ~state.started), live, cfg, params,
+            interpret=cfg.rollout_env_kernel == "interpret",
+        )
+    else:
+        st_m = broker.mark_to_market(st, c, params)
+        st = _select(advance | (live & ~state.started), st_m, st)
     # 4b. maintenance-margin closeout: equity marked below the position's
     #     maintenance requirement forces a liquidation that REPLACES any
     #     pending order and fills at the next bar's open through the
@@ -260,7 +299,10 @@ def step(
     st = st._replace(started=state.started | live)
 
     # ---- reward -----------------------------------------------------------
-    st, base_reward = rewards.compute_reward(st, cfg, params, live)
+    if kernel_env:
+        base_reward = _kernel_base_reward  # computed inside kernel B
+    else:
+        st, base_reward = rewards.compute_reward(st, cfg, params, live)
     fc_row = jnp.minimum(st.t + 1, n - 1)
     penalty = rewards.force_close_penalty(
         st, data.force_close[fc_row - r0], cfg, params

@@ -104,6 +104,12 @@ class EnvConfig:
     # interpret mode on any backend (CPU parity tests); "off" = plain
     # XLA everywhere (the bitwise oracle)
     rollout_obs_kernel: str = "off"          # off | on | interpret
+    # fused env-dynamics kernels (ops/env_dynamics.py): the bar venue's
+    # fill/bracket/financing pass and the mark/reward pass each become
+    # one env-blocked pallas VMEM pass bracketing the strategy kernel.
+    # Same mode contract as rollout_obs_kernel; "off" is the plain-XLA
+    # bitwise oracle (tests/test_env_dynamics_kernel.py pins parity).
+    rollout_env_kernel: str = "off"          # off | on | interpret
     sharpe_window: int = 64
     stage_b_force_close_reward_penalty: bool = False
 
@@ -121,6 +127,11 @@ class EnvConfig:
     lob_scenario: str = "lob_calm"           # lob/scenarios.py preset
     lob_tick_size: float = 1e-5              # quote-currency size of one tick
     lob_lot_units: float = 0.0               # units per lot (0 = position_size)
+    # pallas LOB matching (ops/lob_match.py): the sort-free ranked
+    # matcher replaces the per-message argsort walk for stream
+    # processing (book seeding + the bench depth sweep), exact int32
+    # parity with lob/book.py (tests/test_lob_match_kernel.py)
+    lob_match_kernel: str = "off"            # off | on | interpret
     # feed=scengen + venue=lob: derive per-bar FlowParams from the
     # generated tape's scen_flags (lob/scenarios.flow_params_from_regime)
     # so droughts thin the book and crash bars burst the flow.  Static:
@@ -172,6 +183,41 @@ class EnvConfig:
             raise ValueError(
                 f"rollout_obs_kernel must be off|on|interpret, got "
                 f"{self.rollout_obs_kernel!r}"
+            )
+        if self.rollout_env_kernel not in ("off", "on", "interpret"):
+            raise ValueError(
+                f"rollout_env_kernel must be off|on|interpret, got "
+                f"{self.rollout_env_kernel!r}"
+            )
+        if self.rollout_env_kernel != "off":
+            # honor-or-reject: the fused dynamics kernels cover exactly
+            # the bar venue's fill/bracket/mark/reward scalar ledger.
+            # Anything they cannot reproduce bitwise fails loudly here
+            # instead of silently degrading (validate_lob_venue pattern).
+            if self.venue != "bar":
+                raise ValueError(
+                    "rollout_env_kernel requires venue='bar' (the LOB "
+                    "venue's matching has its own kernel knob, "
+                    "lob_match_kernel)"
+                )
+            if self.reward not in ("pnl_reward", "dd_penalized_reward"):
+                raise ValueError(
+                    "rollout_env_kernel supports reward kernels with "
+                    "packed scalar carries (pnl_reward, "
+                    "dd_penalized_reward); sharpe_reward's per-env ring "
+                    f"buffer and registered kernels are XLA-only, got "
+                    f"{self.reward!r}"
+                )
+            if self.dtype != jnp.float32:
+                raise ValueError(
+                    "rollout_env_kernel requires compute_dtype float32 "
+                    f"(got {self.dtype!r}); the f64 oracle mode stays on "
+                    "the plain-XLA path"
+                )
+        if self.lob_match_kernel not in ("off", "on", "interpret"):
+            raise ValueError(
+                f"lob_match_kernel must be off|on|interpret, got "
+                f"{self.lob_match_kernel!r}"
             )
         if self.margin_model not in ("standard", "leveraged"):
             raise ValueError(f"unknown margin_model {self.margin_model!r}")
@@ -442,6 +488,7 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         reward=str(config.get("reward_plugin", "pnl_reward")),
         obs_kernels=_obs_kernel_names(config.get("obs_plugins")),
         rollout_obs_kernel=str(config.get("rollout_obs_kernel", "off")).lower(),
+        rollout_env_kernel=str(config.get("rollout_env_kernel", "off")).lower(),
         sharpe_window=int(config.get("window", config.get("sharpe_window", 64))),
         stage_b_force_close_reward_penalty=bool(
             config.get("stage_b_force_close_reward_penalty", False)
@@ -455,6 +502,7 @@ def make_env_config(config: Dict[str, Any], *, n_bars: int, n_features: int = 0,
         lob_scenario=str(config.get("lob_scenario", "lob_calm")),
         lob_tick_size=float(config.get("lob_tick_size", 1e-5)),
         lob_lot_units=float(config.get("lob_lot_units", 0.0)),
+        lob_match_kernel=str(config.get("lob_match_kernel", "off")).lower(),
         lob_flow_from_scengen=(
             str(config.get("feed") or "replay").lower() == "scengen"
             and str(config.get("venue", "bar")).lower() == "lob"

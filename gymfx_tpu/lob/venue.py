@@ -133,9 +133,24 @@ def execute_bar(
     h_t = jnp.maximum(price_to_ticks(h, tick), jnp.maximum(o_t, c_t))
     l_t = jnp.minimum(price_to_ticks(l, tick), jnp.minimum(o_t, c_t))
 
-    # fresh per-bar book, seeded with deterministic baseline depth
+    # fresh per-bar book, seeded with deterministic baseline depth;
+    # lob_match_kernel routes the seed stream through the sort-free
+    # pallas matcher (ops/lob_match.py) — exact int32 parity with the
+    # argsort engine, so "on" falling back off-TPU is bitwise safe
     book = empty_book(cfg.lob_depth_levels, cfg.lob_queue_slots)
-    book, _ = process_stream(book, seed_messages(o_t, cfg.lob_seed_levels, fp))
+    seed = seed_messages(o_t, cfg.lob_seed_levels, fp)
+    kernel_match = cfg.lob_match_kernel != "off" and (
+        cfg.lob_match_kernel == "interpret"
+        or jax.default_backend() == "tpu"
+    )
+    if kernel_match:
+        from gymfx_tpu.ops import lob_match
+
+        book, _ = lob_match.fused_process_stream(
+            book, seed, interpret=cfg.lob_match_kernel == "interpret"
+        )
+    else:
+        book, _ = process_stream(book, seed)
 
     lot_units = lot_size(cfg, params)
 

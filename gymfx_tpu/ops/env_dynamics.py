@@ -1,0 +1,388 @@
+"""Pallas TPU kernels: fused per-step env dynamics (broker + reward).
+
+The bar venue's hot loop spends its non-GEMM time in two chains of
+small elementwise ops over per-env ledger scalars, each materializing
+(envs,)-wide intermediates in HBM dozens of times per step:
+
+  A. ``broker.fill_pending`` -> ``broker.check_brackets`` (+ the FX
+     financing accrual) — the order/bracket chain at the bar open;
+  B. ``broker.mark_to_market`` -> ``rewards.compute_reward`` — the
+     equity mark + reward at the bar close.
+
+The strategy kernel sits between the two in ``core/env.step``, so the
+family is TWO env-blocked pallas VMEM passes bracketing it (not one) —
+no reordering of the XLA program, which is what keeps the parity
+argument trivial.  Each kernel packs the touched ``EnvState`` scalars
+into (env_block, n_fields) faces, runs THE SAME ``core/broker`` /
+``core/rewards`` functions elementwise on the block (op-for-op the XLA
+path, including the ``advance``/``mark`` select gating), and repacks.
+The plain-XLA path stays the bitwise oracle
+(tests/test_env_dynamics_kernel.py), exactly like
+``ops/window_zscore.fused_step_obs``.
+
+The trainers' per-env ``vmap`` folds into the grid via
+``jax.custom_batching.custom_vmap`` (the fused-obs pattern); off-TPU
+the "on" mode falls back to XLA and "interpret" runs the pallas
+interpreter for CPU parity tests.  Dispatch lives in ``core/env.step``
+behind the ``rollout_env_kernel`` knob; EnvConfig validation rejects
+configurations the packed-scalar form cannot reproduce (LOB venue,
+sharpe's ring buffer, f64 oracle mode).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from gymfx_tpu.core import broker, rewards
+from gymfx_tpu.core.types import (
+    EXEC_DIAG_INDEX,
+    EXEC_DIAG_KEYS,
+    EnvConfig,
+    EnvParams,
+    EnvState,
+)
+
+_DENIED_IDX = EXEC_DIAG_INDEX["order_denied_min_quantity"]
+
+# EnvState scalars read/written by fill_pending + check_brackets (via
+# apply_fill).  Order is the packing contract between the dispatch
+# wrappers and the kernel bodies.
+FILL_FLOAT_FIELDS = (
+    "pos", "entry_price", "cash_delta", "commission_paid",
+    "last_trade_cost", "trade_pnl_sum", "trade_pnl_sumsq",
+    "open_trade_commission", "pending_target", "pending_sl",
+    "pending_tp", "bracket_sl", "bracket_tp",
+)
+FILL_BOOL_FIELDS = ("pending_active", "pending_forced")
+FILL_INT_FIELDS = ("trade_count", "trades_won", "trades_lost")
+# params consumed by the fill/bracket chain, packed as a broadcast row
+FILL_PARAM_FIELDS = (
+    "slippage", "commission", "price_tick", "size_step", "min_qty",
+)
+
+# EnvState scalars read/written by mark_to_market + compute_reward
+MARK_FLOAT_FIELDS = (
+    "pos", "cash_delta", "equity_delta", "prev_equity_delta",
+    "peak_equity_delta", "max_drawdown_money", "max_drawdown_pct",
+    "reward_peak",
+)
+MARK_OUT_FIELDS = (
+    "equity_delta", "prev_equity_delta", "peak_equity_delta",
+    "max_drawdown_money", "max_drawdown_pct", "reward_peak",
+)
+MARK_PARAM_FIELDS = ("initial_cash", "reward_scale", "penalty_lambda")
+
+
+def _select(pred, a: EnvState, b: EnvState) -> EnvState:
+    # core/env._select, re-derived here to avoid a circular import
+    return EnvState(*(jnp.where(pred, x, y) for x, y in zip(a, b)))
+
+
+def _block_state(float_cols, bool_cols, int_cols, eb: int) -> EnvState:
+    """An EnvState whose listed fields are (eb,) columns and whose
+    untouched fields are typed dummies — the broker/reward functions
+    never read the dummies, and ``_select`` zips over all of them
+    harmlessly (where(pred, 0, 0))."""
+    zf = jnp.zeros((eb,), jnp.float32)
+    zi = jnp.zeros((eb,), jnp.int32)
+    zb = jnp.zeros((eb,), bool)
+    fields = {}
+    for name in EnvState._fields:
+        if name in ("started", "terminated", "pending_active",
+                    "pending_forced"):
+            fields[name] = zb
+        elif name in ("t", "termination_reason", "trade_count",
+                      "trades_won", "trades_lost", "reward_buffer_len",
+                      "reward_buffer_idx", "tr_len", "tr_idx",
+                      "last_coerced_action"):
+            fields[name] = zi
+        elif name == "exec_diag":
+            # (n_counters, eb): row-indexed .at[idx].add works
+            # elementwise across the env block
+            fields[name] = jnp.zeros((len(EXEC_DIAG_KEYS), eb), jnp.int32)
+        elif name == "action_diag":
+            fields[name] = jnp.zeros((1, eb), jnp.int32)
+        else:
+            fields[name] = zf
+    fields.update(float_cols)
+    for name, col in bool_cols.items():
+        fields[name] = col != 0
+    fields.update(int_cols)
+    return EnvState(**fields)
+
+
+def _dummy_params(cols) -> EnvParams:
+    z = jnp.zeros((), jnp.float32)
+    fields = {name: z for name in EnvParams._fields}
+    fields["user"] = ()
+    fields.update(cols)
+    return EnvParams(**fields)
+
+
+# ---------------------------------------------------------------------------
+# Kernel A: fill_pending + check_brackets (+ financing accrual)
+# ---------------------------------------------------------------------------
+def _fill_bracket_kernel(fl_ref, it_ref, bars_ref, pp_ref, out_f_ref,
+                         out_i_ref, *, cfg: EnvConfig):
+    fl = fl_ref[...]                        # (eb, NF) f32
+    it = it_ref[...]                        # (eb, NB + NI + 1) i32
+    bars = bars_ref[...]                    # (eb, 5) f32: o h l c accrual
+    pp = pp_ref[...]                        # (1, NP) f32
+    eb = fl.shape[0]
+
+    float_cols = {n: fl[:, i] for i, n in enumerate(FILL_FLOAT_FIELDS)}
+    nb = len(FILL_BOOL_FIELDS)
+    bool_cols = {n: it[:, i] for i, n in enumerate(FILL_BOOL_FIELDS)}
+    int_cols = {
+        n: it[:, nb + i] for i, n in enumerate(FILL_INT_FIELDS)
+    }
+    advance = it[:, nb + len(FILL_INT_FIELDS)] != 0
+    st = _block_state(float_cols, bool_cols, int_cols, eb)
+    params = _dummy_params(
+        {n: pp[0, i] for i, n in enumerate(FILL_PARAM_FIELDS)}
+    )
+    o, h, l, c = bars[:, 0], bars[:, 1], bars[:, 2], bars[:, 3]
+
+    # op-for-op the core/env.step bar-venue advance (steps 1, 2, 2b)
+    st_f = broker.fill_pending(st, o, params, cfg, h, l)
+    st = _select(advance, st_f, st)
+    st_b = broker.check_brackets(st, o, h, l, cfg, params)
+    st = _select(advance, st_b, st)
+    if cfg.financing_enabled:
+        accrual = st.pos * c * bars[:, 4]
+        st = st._replace(
+            cash_delta=st.cash_delta + jnp.where(advance, accrual, 0.0)
+        )
+
+    out_f_ref[...] = jnp.stack(
+        [getattr(st, n) for n in FILL_FLOAT_FIELDS], axis=-1
+    )
+    out_i_ref[...] = jnp.stack(
+        [getattr(st, n).astype(jnp.int32) for n in FILL_BOOL_FIELDS]
+        + [getattr(st, n) for n in FILL_INT_FIELDS]
+        + [st.exec_diag[_DENIED_IDX]],
+        axis=-1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernel B: mark_to_market + compute_reward
+# ---------------------------------------------------------------------------
+def _mark_reward_kernel(fl_ref, it_ref, pp_ref, out_ref, *,
+                        cfg: EnvConfig):
+    fl = fl_ref[...]                        # (eb, NF + 1) f32 (+close)
+    it = it_ref[...]                        # (eb, 2) i32: mark_pred live
+    pp = pp_ref[...]                        # (1, 3) f32
+    eb = fl.shape[0]
+
+    float_cols = {n: fl[:, i] for i, n in enumerate(MARK_FLOAT_FIELDS)}
+    close = fl[:, len(MARK_FLOAT_FIELDS)]
+    mark_pred = it[:, 0] != 0
+    live = it[:, 1] != 0
+    st = _block_state(float_cols, {}, {}, eb)
+    params = _dummy_params(
+        {n: pp[0, i] for i, n in enumerate(MARK_PARAM_FIELDS)}
+    )
+
+    # op-for-op core/env.step step 4 + the reward block
+    st_m = broker.mark_to_market(st, close, params)
+    st = _select(mark_pred, st_m, st)
+    st, base_reward = rewards.compute_reward(st, cfg, params, live)
+
+    out_ref[...] = jnp.stack(
+        [getattr(st, n) for n in MARK_OUT_FIELDS] + [base_reward],
+        axis=-1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# batched pallas dispatch + custom_vmap plumbing
+# ---------------------------------------------------------------------------
+def _env_block(batch: int, interpret: bool) -> int:
+    """Envs per program.  The per-env footprint is a few dozen scalars,
+    so VMEM never binds; 256 keeps the grid small on flagship batches
+    while interpret mode takes the whole batch in one program (the
+    interpreter's per-program overhead dominates there)."""
+    if interpret:
+        return batch
+    for eb in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if batch % eb == 0:
+            return eb
+    return 1
+
+
+def _row_specs(widths, eb):
+    return [
+        pl.BlockSpec((eb, w), lambda i: (i, 0)) for w in widths[:-1]
+    ] + [pl.BlockSpec((1, widths[-1]), lambda i: (0, 0))]
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fill_bracket(cfg: EnvConfig, interpret: bool):
+    from jax.custom_batching import custom_vmap
+
+    nf, ni = len(FILL_FLOAT_FIELDS), len(FILL_BOOL_FIELDS) + len(FILL_INT_FIELDS) + 1
+    np_ = len(FILL_PARAM_FIELDS)
+    kernel = functools.partial(_fill_bracket_kernel, cfg=cfg)
+
+    def batched(fl, it, bars, pp):
+        b = fl.shape[0]
+        eb = _env_block(b, interpret)
+        return pl.pallas_call(
+            kernel,
+            grid=(b // eb,),
+            in_specs=_row_specs((nf, ni, 5, np_), eb),
+            out_specs=[
+                pl.BlockSpec((eb, nf), lambda i: (i, 0)),
+                pl.BlockSpec((eb, ni), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, nf), jnp.float32),
+                jax.ShapeDtypeStruct((b, ni), jnp.int32),
+            ],
+            interpret=interpret,
+        )(fl, it, bars, pp)
+
+    @custom_vmap
+    def one(fl, it, bars, pp):               # (NF,), (NI,), (5,), (NP,)
+        out_f, out_i = batched(
+            fl[None], it[None], bars[None], pp.reshape(1, -1)
+        )
+        return out_f[0], out_i[0]
+
+    @one.def_vmap
+    def _rule(axis_size, in_batched, fl, it, bars, pp):
+        fl, it, bars, pp = (
+            x if bat else jnp.broadcast_to(x[None], (axis_size, *x.shape))
+            for x, bat in zip((fl, it, bars, pp), in_batched)
+        )
+        # params are identical across envs: one broadcast row
+        out = batched(fl, it, bars, pp[:1])
+        return out, (True, True)
+
+    return one
+
+
+@functools.lru_cache(maxsize=None)
+def _make_mark_reward(cfg: EnvConfig, interpret: bool):
+    from jax.custom_batching import custom_vmap
+
+    nf = len(MARK_FLOAT_FIELDS) + 1
+    no = len(MARK_OUT_FIELDS) + 1
+    kernel = functools.partial(_mark_reward_kernel, cfg=cfg)
+
+    def batched(fl, it, pp):
+        b = fl.shape[0]
+        eb = _env_block(b, interpret)
+        return pl.pallas_call(
+            kernel,
+            grid=(b // eb,),
+            in_specs=_row_specs((nf, 2, len(MARK_PARAM_FIELDS)), eb),
+            out_specs=pl.BlockSpec((eb, no), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, no), jnp.float32),
+            interpret=interpret,
+        )(fl, it, pp)
+
+    @custom_vmap
+    def one(fl, it, pp):
+        return batched(fl[None], it[None], pp.reshape(1, -1))[0]
+
+    @one.def_vmap
+    def _rule(axis_size, in_batched, fl, it, pp):
+        fl, it, pp = (
+            x if bat else jnp.broadcast_to(x[None], (axis_size, *x.shape))
+            for x, bat in zip((fl, it, pp), in_batched)
+        )
+        return batched(fl, it, pp[:1]), True
+
+    return one
+
+
+# ---------------------------------------------------------------------------
+# public entry points (called from core/env.step)
+# ---------------------------------------------------------------------------
+def fused_fill_brackets(
+    st: EnvState, o, h, l, c, accrual_rate, advance, cfg: EnvConfig,
+    params: EnvParams, *, interpret: bool | None = None,
+) -> EnvState:
+    """Kernel A: the advance-gated fill/bracket/financing chain of
+    ``core/env.step`` (steps 1, 2, 2b) as one VMEM pass.  Bitwise
+    identical to the XLA path by construction (same functions, same
+    select gating, packed per-env scalars)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    one = _make_fill_bracket(cfg, bool(interpret))
+    d = st.pos.dtype
+    fl = jnp.stack(
+        [getattr(st, n).astype(jnp.float32) for n in FILL_FLOAT_FIELDS],
+        axis=-1,
+    )
+    it = jnp.stack(
+        [getattr(st, n).astype(jnp.int32) for n in FILL_BOOL_FIELDS]
+        + [getattr(st, n) for n in FILL_INT_FIELDS]
+        + [advance.astype(jnp.int32)],
+        axis=-1,
+    )
+    accrual = (
+        accrual_rate if accrual_rate is not None
+        else jnp.zeros_like(jnp.asarray(o))
+    )
+    bars = jnp.stack(
+        [jnp.asarray(x, jnp.float32) for x in (o, h, l, c, accrual)],
+        axis=-1,
+    )
+    pp = jnp.stack(
+        [getattr(params, n).astype(jnp.float32)
+         for n in FILL_PARAM_FIELDS],
+        axis=-1,
+    )
+    out_f, out_i = one(fl, it, bars, pp)
+    updates = {
+        n: out_f[..., i].astype(d)
+        for i, n in enumerate(FILL_FLOAT_FIELDS)
+    }
+    nb = len(FILL_BOOL_FIELDS)
+    for i, n in enumerate(FILL_BOOL_FIELDS):
+        updates[n] = out_i[..., i] != 0
+    for i, n in enumerate(FILL_INT_FIELDS):
+        updates[n] = out_i[..., nb + i]
+    denied = out_i[..., nb + len(FILL_INT_FIELDS)]
+    updates["exec_diag"] = st.exec_diag.at[..., _DENIED_IDX].add(denied)
+    return st._replace(**updates)
+
+
+def fused_mark_reward(
+    st: EnvState, c, mark_pred, live, cfg: EnvConfig, params: EnvParams,
+    *, interpret: bool | None = None,
+):
+    """Kernel B: the mark/drawdown/reward chain of ``core/env.step``
+    (step 4 + the reward block) as one VMEM pass.  Returns
+    (new_state, base_reward); the reward carries are updated at the
+    mark's program position — nothing between mark and reward in the
+    XLA step reads or writes them, so the final state is identical."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    one = _make_mark_reward(cfg, bool(interpret))
+    d = st.pos.dtype
+    fl = jnp.stack(
+        [getattr(st, n).astype(jnp.float32) for n in MARK_FLOAT_FIELDS]
+        + [jnp.asarray(c, jnp.float32)],
+        axis=-1,
+    )
+    it = jnp.stack(
+        [mark_pred.astype(jnp.int32), live.astype(jnp.int32)], axis=-1
+    )
+    pp = jnp.stack(
+        [getattr(params, n).astype(jnp.float32)
+         for n in MARK_PARAM_FIELDS],
+        axis=-1,
+    )
+    out = one(fl, it, pp)
+    updates = {
+        n: out[..., i].astype(d) for i, n in enumerate(MARK_OUT_FIELDS)
+    }
+    base_reward = out[..., len(MARK_OUT_FIELDS)].astype(d)
+    return st._replace(**updates), base_reward

@@ -1,0 +1,345 @@
+"""Pallas TPU kernel: sort-free LOB stream matching.
+
+``lob/book.process_stream`` is a ``lax.scan`` of ``lax.switch`` message
+dispatch whose hot op is an ``argsort`` over the flattened price-time
+keys — a sort the TPU vector unit has no native lowering for, so XLA
+serializes it through expensive generic sorts per message.  This module
+re-derives every half-book primitive in sort-free dense int32 algebra
+so the whole stream runs as ONE pallas program per book (grid over
+books, ``fori_loop`` over messages, book state resident in VMEM):
+
+  * matching: each slot's fill is ``clip(take - prior, 0, avail)``
+    where ``prior`` is the liquidity strictly ahead of it in price-time
+    priority — the sum over strictly-better level keys plus the FIFO
+    prefix within its own level.  Identical to the sorted cumsum walk
+    because live levels never share a price, so flattened keys are
+    unique wherever liquidity exists;
+  * queue compaction: each live slot moves to its rank = count of live
+    slots before it (exclusive prefix sum) — the stable
+    ``argsort(qty == 0)`` without the sort;
+  * resting/cancelling: first-free-index selects become masked-min +
+    one-hot dense updates.
+
+Message dispatch is dense too: every branch (add buy/sell, cancel,
+market) is computed and the result selected by kind/side — exact,
+because all branches are pure int32 and a zero-quantity match /
+zero-oid cancel / zero-lot rest is a bitwise no-op on an invariant
+book (front-compacted queues, zero oid in empty slots, zero price on
+empty levels).  ``tests/test_lob_match_kernel.py`` pins exact int32
+parity against ``book.process_stream`` message-for-message.
+
+Dispatch: ``lob/venue.execute_bar`` (per-bar seed stream) and
+``bench.py --lob`` behind the ``lob_match_kernel`` off|on|interpret
+knob — "off" keeps the argsort engine (the oracle), "on" uses pallas
+on TPU and falls back to the oracle elsewhere (bitwise safe: both are
+exact), "interpret" forces the pallas interpreter for CPU parity
+tests.  The intrabar agent flow scan keeps the oracle engine: its
+per-message ``lax.cond`` stop-trigger logic is agent bookkeeping, not
+matching.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from gymfx_tpu.lob.book import (
+    AGENT_OID,
+    MSG_ADD,
+    MSG_CANCEL,
+    MSG_MARKET,
+    PRICE_CAP,
+    BookState,
+    FillRecord,
+    Messages,
+)
+
+_FILL_COLS = len(FillRecord._fields)
+
+
+def _iota(shape, dim):
+    # 1D iota is not allowed on TPU pallas; broadcasted_iota always is
+    return jax.lax.broadcasted_iota(jnp.int32, shape, dim)
+
+
+def _prefix_sum_q(x):
+    """Exclusive prefix sum along axis 1 — a static-Q loop of masked
+    adds instead of ``cumsum`` (no scan lowering needed in-kernel)."""
+    cols = _iota(x.shape, 1)
+    out = jnp.zeros_like(x)
+    for b in range(x.shape[1]):
+        out = out + jnp.where(cols > b, x[:, b:b + 1], 0)
+    return out
+
+
+def _first_true(mask, size):
+    """Index of the first True (``size`` when none) — ``argmax`` on
+    bool without the argmax: masked min over the iota."""
+    return jnp.min(jnp.where(mask, _iota(mask.shape, 0), size))
+
+
+def _compact_dense(qty, oid):
+    """``book._compact`` without the argsort: each live slot moves to
+    its rank (count of live slots before it); dead slots zero-fill.
+    Exact: ranks of live slots are distinct and increasing, which IS
+    the stable sort order."""
+    live = qty > 0
+    rank = _prefix_sum_q(live.astype(jnp.int32))
+    cols = _iota(qty.shape, 1)
+    new_qty = jnp.zeros_like(qty)
+    new_oid = jnp.zeros_like(oid)
+    for j in range(qty.shape[1]):
+        m = live[:, j:j + 1] & (cols == rank[:, j:j + 1])
+        new_qty = jnp.where(m, qty[:, j:j + 1], new_qty)
+        new_oid = jnp.where(m, oid[:, j:j + 1], new_oid)
+    return new_qty, new_oid
+
+
+def _reset_empty_levels(price, qty):
+    return jnp.where(jnp.sum(qty, axis=1, dtype=jnp.int32) > 0, price, 0)
+
+
+def _match_half(price, qty, oid, take_qty, limit, against_asks: bool):
+    """``book._match_half`` with the sorted cumsum walk replaced by the
+    prior-liquidity form: fill_j = clip(take - prior_j, 0, avail_j),
+    prior_j = liquidity at strictly better price-time keys.  Bitwise
+    identical because keys are unique wherever avail > 0."""
+    active = price > 0
+    if against_asks:
+        eligible = active & (price <= limit)
+        level_key = jnp.where(eligible, price, PRICE_CAP)
+    else:
+        eligible = active & (price >= limit)
+        level_key = jnp.where(eligible, PRICE_CAP - price, PRICE_CAP)
+    avail = jnp.where(eligible[:, None], qty, 0)
+
+    level_avail = jnp.sum(avail, axis=1, dtype=jnp.int32)          # (D,)
+    ahead_levels = jnp.sum(
+        jnp.where(level_key[None, :] < level_key[:, None],
+                  level_avail[None, :], 0),
+        axis=1, dtype=jnp.int32,
+    )
+    prior = ahead_levels[:, None] + _prefix_sum_q(avail)
+    fill = jnp.clip(take_qty - prior, 0, avail)
+
+    # sums pinned to int32 (the book.py x64 rule)
+    filled = jnp.sum(fill, dtype=jnp.int32)
+    value = jnp.sum(fill * price[:, None], dtype=jnp.int32)
+    events = jnp.sum(fill > 0, dtype=jnp.int32)
+    agent = (oid == AGENT_OID) & (fill > 0)
+    agent_fill = jnp.where(agent, fill, 0)
+    agent_qty = jnp.sum(agent_fill, dtype=jnp.int32)
+    agent_value = jnp.sum(agent_fill * price[:, None], dtype=jnp.int32)
+    touched = jnp.sum(fill, axis=1, dtype=jnp.int32) > 0
+    pmin = jnp.min(jnp.where(touched, price, PRICE_CAP))
+    pmax = jnp.max(jnp.where(touched, price, 0))
+
+    new_qty = qty - fill
+    new_oid = jnp.where(new_qty > 0, oid, 0)
+    new_qty, new_oid = _compact_dense(new_qty, new_oid)
+    new_price = _reset_empty_levels(price, new_qty)
+    stats = (filled, value, events, agent_qty, agent_value, pmin, pmax)
+    return (new_price, new_qty, new_oid), stats
+
+
+def _rest_half(price, qty, oid, p, q, o):
+    """``book._rest_half`` with the (li, si) scatter as a one-hot dense
+    update.  li = D (empty one-hot, no write) when neither an existing
+    level nor a free one exists — the original's ``can`` gate."""
+    D, Q = qty.shape
+    has_level = (price == p) & (price > 0)
+    level_free = jnp.sum(qty, axis=1, dtype=jnp.int32) == 0
+    li = jnp.where(
+        jnp.any(has_level),
+        _first_true(has_level, D),
+        _first_true(level_free, D),
+    )
+    can = (q > 0) & (jnp.any(has_level) | jnp.any(level_free))
+    lvl = _iota((D,), 0) == li
+    free = qty == 0
+    si_per_level = jnp.min(jnp.where(free, _iota((D, Q), 1), Q), axis=1)
+    si = jnp.sum(jnp.where(lvl, si_per_level, 0), dtype=jnp.int32)
+    can = can & jnp.any(lvl & jnp.any(free, axis=1))
+    slot = can & lvl[:, None] & (_iota((D, Q), 1) == si)
+    rested = jnp.where(can, q, 0)
+    qty = jnp.where(slot, q, qty)
+    oid = jnp.where(slot, o, oid)
+    price = jnp.where(can & lvl, p, price)
+    return (price, qty, oid), rested
+
+
+def _cancel_half(price, qty, oid, target_oid):
+    hit = (oid == target_oid) & (qty > 0) & (target_oid != 0)
+    removed = jnp.sum(jnp.where(hit, qty, 0), dtype=jnp.int32)
+    qty = jnp.where(hit, 0, qty)
+    oid = jnp.where(hit, 0, oid)
+    qty, oid = _compact_dense(qty, oid)
+    price = _reset_empty_levels(price, qty)
+    return (price, qty, oid), removed
+
+
+def _process_message_dense(halves, msg):
+    """``book.process_message`` with the lax.switch/cond dispatch as
+    dense compute-all-branches-and-select — every branch is pure int32
+    and the inapplicable ones are bitwise no-ops (zero take / zero rest
+    / zero cancel target) on an invariant book."""
+    bp, bq, bo, ap, aq, ao = halves
+    kind, side, price, qty, oid = msg
+    k = jnp.clip(kind, 0, 3)
+    is_buy = side > 0
+    is_add = k == MSG_ADD
+    is_cancel = k == MSG_CANCEL
+    matchable = is_add | (k == MSG_MARKET)
+
+    # taker match against the opposite side
+    ask_take = jnp.where(matchable & is_buy, qty, 0)
+    ask_limit = jnp.where(is_add, price, PRICE_CAP)
+    (ap, aq, ao), s_a = _match_half(ap, aq, ao, ask_take, ask_limit, True)
+    bid_take = jnp.where(matchable & ~is_buy, qty, 0)
+    bid_limit = jnp.where(is_add, price, 0)
+    (bp, bq, bo), s_b = _match_half(bp, bq, bo, bid_take, bid_limit, False)
+
+    # rest an ADD's unmatched remainder on its own side
+    bid_rest = jnp.where(is_add & is_buy, qty - s_a[0], 0)
+    (bp, bq, bo), rest_b = _rest_half(bp, bq, bo, price, bid_rest, oid)
+    ask_rest = jnp.where(is_add & ~is_buy, qty - s_b[0], 0)
+    (ap, aq, ao), rest_a = _rest_half(ap, aq, ao, price, ask_rest, oid)
+
+    # cancel by (side, oid); target 0 hits nothing
+    (bp, bq, bo), rm_b = _cancel_half(
+        bp, bq, bo, jnp.where(is_cancel & is_buy, oid, 0)
+    )
+    (ap, aq, ao), rm_a = _cancel_half(
+        ap, aq, ao, jnp.where(is_cancel & ~is_buy, oid, 0)
+    )
+
+    rec = FillRecord(
+        filled_qty=s_a[0] + s_b[0],
+        filled_value=s_a[1] + s_b[1],
+        fill_events=s_a[2] + s_b[2],
+        agent_qty=s_a[3] + s_b[3],
+        agent_value=s_a[4] + s_b[4],
+        price_min=jnp.minimum(s_a[5], s_b[5]),
+        price_max=jnp.maximum(s_a[6], s_b[6]),
+        rested_qty=rest_b + rest_a,
+        cancelled_qty=rm_b + rm_a,
+    )
+    return (bp, bq, bo, ap, aq, ao), rec
+
+
+def process_stream_dense(book: BookState, msgs: Messages):
+    """XLA twin of the kernel body (same dense math, no pallas) — the
+    parity tests use it to separate ranked-math bugs from pallas
+    lowering bugs.  Not a dispatch target."""
+
+    def step(halves, m):
+        return _process_message_dense(halves, m)
+
+    halves, fills = jax.lax.scan(step, tuple(book), tuple(msgs))
+    return BookState(*halves), fills
+
+
+# ---------------------------------------------------------------------------
+# pallas dispatch: one book per program, fori_loop over the stream
+# ---------------------------------------------------------------------------
+def _stream_kernel(bp_ref, bq_ref, bo_ref, ap_ref, aq_ref, ao_ref,
+                   k_ref, s_ref, p_ref, q_ref, o_ref,
+                   obp_ref, obq_ref, obo_ref, oap_ref, oaq_ref, oao_ref,
+                   of_ref):
+    halves = (bp_ref[0], bq_ref[0], bo_ref[0],
+              ap_ref[0], aq_ref[0], ao_ref[0])
+    stream = (k_ref[0], s_ref[0], p_ref[0], q_ref[0], o_ref[0])
+    n_msgs = stream[0].shape[0]
+    fills0 = jnp.zeros((n_msgs, _FILL_COLS), jnp.int32)
+
+    def body(m, carry):
+        halves, fills = carry
+        msg = tuple(
+            jax.lax.dynamic_index_in_dim(x, m, keepdims=False)
+            for x in stream
+        )
+        halves, rec = _process_message_dense(halves, msg)
+        row = jnp.stack(list(rec))[None, :]
+        fills = jax.lax.dynamic_update_slice(fills, row, (m, 0))
+        return halves, fills
+
+    halves, fills = jax.lax.fori_loop(0, n_msgs, body, (halves, fills0))
+    obp_ref[0] = halves[0]
+    obq_ref[0] = halves[1]
+    obo_ref[0] = halves[2]
+    oap_ref[0] = halves[3]
+    oaq_ref[0] = halves[4]
+    oao_ref[0] = halves[5]
+    of_ref[0] = fills
+
+
+@functools.lru_cache(maxsize=None)
+def _make_stream(depth: int, slots: int, n_msgs: int, interpret: bool):
+    from jax.custom_batching import custom_vmap
+
+    lvl = pl.BlockSpec((1, depth), lambda i: (i, 0))
+    slab = pl.BlockSpec((1, depth, slots), lambda i: (i, 0, 0))
+    msg = pl.BlockSpec((1, n_msgs), lambda i: (i, 0))
+    fill = pl.BlockSpec((1, n_msgs, _FILL_COLS), lambda i: (i, 0, 0))
+
+    def batched(bp, bq, bo, ap, aq, ao, k, s, p, q, o):
+        b = bp.shape[0]
+        return pl.pallas_call(
+            _stream_kernel,
+            grid=(b,),
+            in_specs=[lvl, slab, slab, lvl, slab, slab,
+                      msg, msg, msg, msg, msg],
+            out_specs=[lvl, slab, slab, lvl, slab, slab, fill],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, depth), jnp.int32),
+                jax.ShapeDtypeStruct((b, depth, slots), jnp.int32),
+                jax.ShapeDtypeStruct((b, depth, slots), jnp.int32),
+                jax.ShapeDtypeStruct((b, depth), jnp.int32),
+                jax.ShapeDtypeStruct((b, depth, slots), jnp.int32),
+                jax.ShapeDtypeStruct((b, depth, slots), jnp.int32),
+                jax.ShapeDtypeStruct((b, n_msgs, _FILL_COLS), jnp.int32),
+            ],
+            interpret=interpret,
+        )(bp, bq, bo, ap, aq, ao, k, s, p, q, o)
+
+    @custom_vmap
+    def one(bp, bq, bo, ap, aq, ao, k, s, p, q, o):
+        out = batched(*(x[None] for x in (bp, bq, bo, ap, aq, ao,
+                                          k, s, p, q, o)))
+        return tuple(y[0] for y in out)
+
+    @one.def_vmap
+    def _rule(axis_size, in_batched, *args):
+        args = tuple(
+            x if bat else jnp.broadcast_to(x[None], (axis_size, *x.shape))
+            for x, bat in zip(args, in_batched)
+        )
+        return tuple(batched(*args)), (True,) * 7
+
+    return one
+
+
+def fused_process_stream(
+    book: BookState, msgs: Messages, *, interpret: bool | None = None,
+):
+    """``book.process_stream`` as one pallas program per book: the book
+    lives in VMEM across the whole stream and every message is matched
+    with the sort-free dense primitives.  Exact int32 parity with the
+    argsort engine (tests/test_lob_match_kernel.py).  Composes with the
+    trainers' per-env ``vmap`` via custom_vmap (batch -> grid)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    depth = int(book.bid_qty.shape[-2])
+    slots = int(book.bid_qty.shape[-1])
+    n_msgs = int(msgs.kind.shape[-1])
+    one = _make_stream(depth, slots, n_msgs, bool(interpret))
+    arrays = tuple(
+        jnp.asarray(x, jnp.int32) for x in (*book, *msgs)
+    )
+    out = one(*arrays)
+    new_book = BookState(*out[:6])
+    fills = out[6]
+    rec = FillRecord(*(fills[..., i] for i in range(_FILL_COLS)))
+    return new_book, rec

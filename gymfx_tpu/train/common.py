@@ -40,6 +40,72 @@ def make_train_many(step_impl):
     return jax.jit(impl, static_argnums=1, donate_argnums=0)
 
 
+def make_train_many_overlapped(
+    rollout_phase, update_phase, learner_fields=("params", "opt_state"),
+):
+    """Software-pipelined superstep driver: jitted ``train_many(state,
+    k)`` where iteration ``i+1``'s rollout is ISSUED in the same scan
+    body as iteration ``i``'s update, so the XLA scheduler can overlap
+    the rollout's small-op env chain with the update's GEMM chain
+    instead of running the two phases back to back.
+
+    Shape: prologue rollout, then ``k - 1`` pipelined bodies
+    {rollout(i+1) on pre-update params || update(i)}, then the epilogue
+    update — the same number of rollouts and updates as the sequential
+    driver.  ``learner_fields`` names the state fields the update owns
+    (params/opt state/actor-sync counters); the body grafts them from
+    the update's result onto the already-issued rollout's carry.
+
+    Semantics (why this is OPT-IN, ``superstep_overlap`` in
+    config/defaults.py):
+
+      * rollouts act on params ONE update stale — the standard
+        actor-learner pipelining trade (IMPALA makes it explicit with
+        V-trace; for PPO the stored log-probs stay self-consistent, the
+        data is just one policy version old);
+      * the guard's quarantine env resets (and any other update-side
+        edits to env/obs/carry state) are dropped inside a dispatch,
+        because the next rollout already consumed the pre-update state;
+      * the rollout/update RNG streams are pre-split per body so the
+        two concurrent phases never share a key.
+
+    ``k=1`` has no pipelined body — prologue + epilogue compose exactly
+    the sequential train step, which the parity test pins bitwise
+    (tests/test_overlap_superstep.py).  Metrics return stacked on a
+    leading ``(k,)`` axis like :func:`make_train_many`.
+    """
+
+    def merge(rolled, updated):
+        return rolled._replace(
+            **{f: getattr(updated, f) for f in learner_fields}
+        )
+
+    def impl(state, k: int):
+        inter, ro = rollout_phase(state)
+
+        def body(carry, _):
+            inter, ro = carry
+            r_next, r_upd = jax.random.split(inter.rng)
+            inter2, ro2 = rollout_phase(inter._replace(rng=r_next))
+            updated, metrics = update_phase(inter._replace(rng=r_upd), ro)
+            return (merge(inter2, updated), ro2), metrics
+
+        if k > 1:
+            (inter, ro), stacked = jax.lax.scan(
+                body, (inter, ro), None, length=k - 1
+            )
+        final, last = update_phase(inter, ro)
+        if k > 1:
+            metrics = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b[None]]), stacked, last
+            )
+        else:
+            metrics = jax.tree.map(lambda x: x[None], last)
+        return final, metrics
+
+    return jax.jit(impl, static_argnums=1, donate_argnums=0)
+
+
 def build_train_eval_envs(config: Dict[str, Any]) -> Tuple[Any, Optional[Any]]:
     """(train_env, eval_env-or-None) honoring the out-of-sample keys.
 

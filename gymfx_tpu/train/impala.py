@@ -66,6 +66,13 @@ class ImpalaConfig(NamedTuple):
     # update when loss/grads go non-finite and quarantine-reset envs
     # whose segment produced NaN/inf (see train/ppo.py)
     nonfinite_guard: bool = True
+    # Adam first-moment storage dtype — resolved through the shared
+    # master-weight rule (train/ppo.resolve_optimizer_state_dtype)
+    opt_state_dtype: Any = jnp.float32
+    # software-pipelined superstep driver (see train/ppo.PPOConfig);
+    # for IMPALA the one-update-stale rollout params are the NATIVE
+    # regime — V-trace corrects actor/learner staleness by design
+    superstep_overlap: bool = False
 
 
 def _resolve_collect_dtype(config, policy_dtype):
@@ -74,6 +81,12 @@ def _resolve_collect_dtype(config, policy_dtype):
     from gymfx_tpu.train.ppo import resolve_collect_dtype
 
     return resolve_collect_dtype(config, policy_dtype)
+
+
+def _resolve_opt_state_dtype(config):
+    from gymfx_tpu.train.ppo import resolve_optimizer_state_dtype
+
+    return resolve_optimizer_state_dtype(config)
 
 
 def impala_config_from(config: Dict[str, Any]) -> ImpalaConfig:
@@ -99,6 +112,8 @@ def impala_config_from(config: Dict[str, Any]) -> ImpalaConfig:
         ),
         collect_dtype=_resolve_collect_dtype(config, dt),
         nonfinite_guard=bool(config.get("nonfinite_guard", True)),
+        opt_state_dtype=_resolve_opt_state_dtype(config),
+        superstep_overlap=bool(config.get("superstep_overlap", False)),
     )
 
 
@@ -139,7 +154,7 @@ class ImpalaTrainer:
         )
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(icfg.max_grad_norm),
-            optax.adam(icfg.lr),
+            optax.adam(icfg.lr, mu_dtype=icfg.opt_state_dtype),
         )
         cfg, params = env.cfg, env.params
         if hasattr(env, "require_resident_data"):
@@ -156,9 +171,23 @@ class ImpalaTrainer:
         self.obs_spec = make_obs_spec(reset_obs)
         self._reset_vec = self._encode(reset_obs)
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
-        from gymfx_tpu.train.common import make_train_many
+        from gymfx_tpu.train.common import (
+            make_train_many,
+            make_train_many_overlapped,
+        )
 
-        self._train_many = make_train_many(self._train_step_impl)
+        if icfg.superstep_overlap:
+            # the update phase owns both param sets (learner gradients,
+            # periodic actor sync) and the staleness counter
+            self._train_many = make_train_many_overlapped(
+                self._rollout_phase, self._update_phase,
+                learner_fields=(
+                    "learner_params", "actor_params", "opt_state",
+                    "updates_since_sync",
+                ),
+            )
+        else:
+            self._train_many = make_train_many(self._train_step_impl)
 
     def _encode(self, obs):
         spec = getattr(self, "obs_spec", None)

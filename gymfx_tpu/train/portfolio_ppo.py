@@ -130,6 +130,10 @@ class PortfolioPPOConfig(NamedTuple):
     # ((T, N, window*pairs*features) portfolio obs); resolved like the
     # single-pair trainers (train/ppo.resolve_collect_dtype)
     collect_dtype: Any = jnp.float32
+    # Adam first-moment dtype (train/ppo.resolve_optimizer_state_dtype):
+    # only mu narrows — nu feeds the 1/sqrt(nu) rescale and stays f32
+    # alongside the master weights
+    opt_state_dtype: Any = jnp.float32
 
 
 class PortfolioTrainState(NamedTuple):
@@ -220,7 +224,7 @@ class PortfolioPPOTrainer:
     def _make_optimizer(self):
         return optax.chain(
             optax.clip_by_global_norm(self.pcfg.max_grad_norm),
-            optax.adam(self.pcfg.lr),
+            optax.adam(self.pcfg.lr, mu_dtype=self.pcfg.opt_state_dtype),
         )
 
     def init_state(self, seed: int = 0) -> PortfolioTrainState:
@@ -545,7 +549,10 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
     env, eval_env = build_portfolio_train_eval_envs(config)
     from gymfx_tpu.train.common import resolve_minibatch_scheme
-    from gymfx_tpu.train.ppo import resolve_collect_dtype
+    from gymfx_tpu.train.ppo import (
+        resolve_collect_dtype,
+        resolve_optimizer_state_dtype,
+    )
 
     n_envs = int(config.get("num_envs", 64) or 64)
     resolve_minibatch_scheme(
@@ -566,6 +573,7 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         ),
         policy_dtype=pdt,
         collect_dtype=resolve_collect_dtype(config, pdt),
+        opt_state_dtype=resolve_optimizer_state_dtype(config),
     )
     from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
 

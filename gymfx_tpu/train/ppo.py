@@ -79,6 +79,22 @@ class PPOConfig(NamedTuple):
     # rollout produced NaN/inf — one poisoned feed bar no longer
     # corrupts the train state irrecoverably
     nonfinite_guard: bool = True
+    # Adam first-moment storage dtype (the largest optimizer buffer).
+    # bfloat16 halves its HBM footprint/traffic; params and the second
+    # moment stay float32 — the master-weight rule, mirrored on
+    # resolve_collect_dtype and gated by a learning-parity smoke
+    # (tests/test_opt_state_dtype.py).  float32 = bitwise-identical
+    # default (optax stores mu in the param dtype either way).
+    opt_state_dtype: Any = jnp.float32
+    # software-pipelined superstep driver
+    # (train/common.make_train_many_overlapped): rollout i+1 issues
+    # alongside update i inside train_many dispatches.  Opt-in — see
+    # the semantics note on that function.
+    superstep_overlap: bool = False
+    # rematerialize the policy forward inside the PPO loss (jax.remat):
+    # the backward GEMM chain recomputes activations in VMEM instead of
+    # staging them through HBM — same math, fewer HBM round trips
+    update_remat: bool = False
 
 
 def resolve_collect_dtype(config: Dict[str, Any], policy_dtype) -> Any:
@@ -95,6 +111,23 @@ def resolve_collect_dtype(config: Dict[str, Any], policy_dtype) -> Any:
     if policy_dtype == jnp.bfloat16 or cd == jnp.bfloat16:
         return jnp.bfloat16
     return cd
+
+
+def resolve_optimizer_state_dtype(config: Dict[str, Any]) -> Any:
+    """Adam first-moment storage dtype from the config knob.  The
+    master-weight rule is fixed, not configurable: only ``mu`` narrows
+    (it is a smoothed gradient — bf16's ~3 decimal digits track it),
+    while params and ``nu`` stay float32 (``nu`` feeds the 1/sqrt
+    rescale where bf16 quantization would modulate the effective lr).
+    Mirrors :func:`resolve_collect_dtype`'s one-definition discipline —
+    every trainer resolves through here."""
+    dt = str(config.get("optimizer_state_dtype", "float32")).lower()
+    if dt not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"optimizer_state_dtype must be 'float32' or 'bfloat16', "
+            f"got {dt!r}"
+        )
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dt]
 
 
 def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
@@ -124,6 +157,9 @@ def ppo_config_from(config: Dict[str, Any]) -> PPOConfig:
         ),
         collect_dtype=resolve_collect_dtype(config, dt),
         nonfinite_guard=bool(config.get("nonfinite_guard", True)),
+        opt_state_dtype=resolve_optimizer_state_dtype(config),
+        superstep_overlap=bool(config.get("superstep_overlap", False)),
+        update_remat=bool(config.get("ppo_update_remat", False)),
     )
 
 
@@ -189,15 +225,23 @@ class PPOTrainer:
 
         self._random_start = bool(env.config.get("random_episode_start", False))
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
-        from gymfx_tpu.train.common import make_train_many
+        from gymfx_tpu.train.common import (
+            make_train_many,
+            make_train_many_overlapped,
+        )
 
-        self._train_many = make_train_many(self._train_step_impl)
+        if pcfg.superstep_overlap:
+            self._train_many = make_train_many_overlapped(
+                self._rollout_phase, self._update_phase
+            )
+        else:
+            self._train_many = make_train_many(self._train_step_impl)
 
     # ------------------------------------------------------------------
     def _make_optimizer(self):
         return optax.chain(
             optax.clip_by_global_norm(self.pcfg.max_grad_norm),
-            optax.adam(self.pcfg.lr),
+            optax.adam(self.pcfg.lr, mu_dtype=self.pcfg.opt_state_dtype),
         )
 
     def _encode(self, obs: Dict[str, Any]):
@@ -333,9 +377,14 @@ class PPOTrainer:
         return advs, returns
 
     def _loss(self, params, batch):
-        dist, value, _ = jax.vmap(
-            self._policy_forward, in_axes=(None, 0, 0)
-        )(params, batch["obs"], batch["pcarry"])
+        fwd = jax.vmap(self._policy_forward, in_axes=(None, 0, 0))
+        if self.pcfg.update_remat:
+            # recompute the forward activations inside the backward pass
+            # (same ops, same order — no numeric change) instead of
+            # staging every minibatch activation through HBM; on TPU the
+            # whole loss GEMM chain then runs VMEM-resident
+            fwd = jax.remat(fwd)
+        dist, value, _ = fwd(params, batch["obs"], batch["pcarry"])
         if self._continuous:
             mu, log_std = dist
             logp = _normal_logp(batch["action"], mu, log_std)

@@ -45,6 +45,15 @@ def test_bench_quick_prints_single_json_line_contract():
     for key in ("rollout_ms", "update_ms"):
         assert key in payload, (key, payload)
         assert payload[key] is not None and payload[key] > 0, (key, payload)
+    # r10 overlap accounting: overlap savings need a K>1 superstep to
+    # measure against, so K=1 reports null — never a fabricated number
+    assert "overlap_ms_saved" in payload, payload
+    assert payload["overlap_ms_saved"] is None
+    # the update phase's FLOP share comes off the same XLA cost
+    # analysis as rollout_ms/update_ms and is a real fraction on CPU
+    assert "update_gemm_frac" in payload, payload
+    if payload["update_gemm_frac"] is not None:
+        assert 0.0 < payload["update_gemm_frac"] <= 1.0, payload
 
 
 @pytest.mark.slow
@@ -112,6 +121,14 @@ def test_lob_bench_quick_emits_schema_valid_fills_row():
     # headline row == the venue-default depth-24 sweep entry
     assert payload["depth_levels"] == 24
     assert payload["value"] == payload["depth_sweep"]["24"]["fills_per_sec"]
+    # r10: every bench row carries the analytic-MFU key block (shared
+    # emitter bench_util.emit_bench_record) — null on CPU / for integer
+    # matching, but the KEYS are pinned so dashboards parse one schema
+    for key in ("analytic_flops_per_step", "hw_flops_peak",
+                "mfu_analytic", "device_memory_bytes"):
+        assert key in payload, (key, payload)
+    assert payload["mfu_analytic"] is None  # no FLOP model for matching
+    assert payload["lob_match_kernel"] == "off"  # oracle is the default
 
 
 def test_scengen_bench_quick_emits_schema_valid_bars_row():
@@ -140,6 +157,11 @@ def test_scengen_bench_quick_emits_schema_valid_bars_row():
         assert row["bars_per_sec"] > 0 and row["gen_ms"] > 0
     assert payload["value"] == \
         payload["preset_sweep"]["regime_mix"]["bars_per_sec"]
+    # r10: the shared emitter's analytic-MFU key block (null on CPU)
+    for key in ("analytic_flops_per_step", "hw_flops_peak",
+                "mfu_analytic", "device_memory_bytes"):
+        assert key in payload, (key, payload)
+    assert payload["mfu_analytic"] is None
 
 
 @pytest.mark.slow

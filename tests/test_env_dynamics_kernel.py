@@ -1,0 +1,137 @@
+"""Bitwise parity for the fused env-dynamics kernel family (r10).
+
+``rollout_env_kernel`` swaps the bar venue's fill/bracket/financing
+chain (kernel A) and the mark/reward chain (kernel B) for env-blocked
+pallas passes — nothing else — so full rollouts under the kernels must
+be BITWISE identical to the plain-XLA step: same ledger, same rewards,
+same trajectories, across strategies, rewards, and the slippage /
+quantization / margin config axes the broker chain branches on.  Runs
+in pallas interpret mode so the parity gate holds on CPU CI (the
+tests/test_rollout_obs_kernel.py pattern).
+"""
+import jax
+import numpy as np
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core.rollout import random_driver, rollout
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+from helpers import make_df
+
+
+def _df(n=200, seed=3):
+    rng = np.random.default_rng(seed)
+    closes = 1.1 * np.exp(np.cumsum(rng.normal(0, 3e-4, n)))
+    spread = np.abs(rng.normal(0, 2e-4, n)) + 5e-5
+    return make_df(closes, highs=closes + spread, lows=closes - spread)
+
+
+def _env(kernel_mode, **over):
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1",
+                  rollout_env_kernel=kernel_mode)
+    config.update(over)
+    return Environment(config, dataset=MarketDataset(_df(), config))
+
+
+def _tree_equal(a, b, label):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), label
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"{label}: leaf {i}"
+        )
+
+
+def _compare_rollout(over, label, steps=48):
+    e_xla = _env("off", **over)
+    e_ker = _env("interpret", **over)
+    rng = jax.random.PRNGKey(7)
+    st_xla, tr_xla = rollout(
+        e_xla.cfg, e_xla.params, e_xla.data, random_driver(), steps, rng
+    )
+    st_ker, tr_ker = rollout(
+        e_ker.cfg, e_ker.params, e_ker.data, random_driver(), steps, rng
+    )
+    _tree_equal(st_xla, st_ker, f"{label}: final state")
+    _tree_equal(tr_xla, tr_ker, f"{label}: trajectory")
+
+
+@pytest.mark.parametrize("over, label", [
+    ({}, "default"),
+    ({"strategy_plugin": "direct_fixed_sltp", "slippage": 1e-4,
+      "commission": 2e-5}, "brackets+slip+commission"),
+    ({"strategy_plugin": "direct_atr_sltp", "reward_plugin":
+      "dd_penalized_reward", "slippage": 1e-4}, "atr+dd_reward"),
+    ({"strategy_plugin": "direct_fixed_sltp", "venue_quantization": True,
+      "instrument": "EUR_USD", "slippage": 1e-4}, "venue_quantization"),
+    ({"strategy_plugin": "direct_fixed_sltp", "slip_limit": True,
+      "slip_match": True, "slippage": 2e-4}, "slip_switches"),
+    ({"strategy_plugin": "direct_fixed_sltp",
+      "enforce_margin_preflight": True, "enforce_margin_closeout": True,
+      "leverage": 30.0, "position_size": 200000.0,
+      "slippage": 1e-4}, "margin+closeout"),
+    ({"financing_enabled": True, "strategy_plugin": "direct_fixed_sltp",
+      "financing_rate_data_file":
+      "examples/data/fx_rollover_rates_smoke.csv"}, "financing"),
+    ({"limit_fill_policy": "touch", "intrabar_collision_policy": "ohlc",
+      "strategy_plugin": "direct_fixed_sltp"}, "fill_policies"),
+])
+def test_kernel_rollout_bitwise_matches_xla(over, label):
+    _compare_rollout(over, label)
+
+
+def test_kernel_train_step_bitwise_matches_xla():
+    """One full jitted PPO train step (vmapped envs, rollout + update):
+    the fused dynamics feed rewards and obs into the update, so any
+    ledger divergence would surface in the new params."""
+
+    def trainer(mode):
+        config = dict(DEFAULT_VALUES)
+        config.update(window_size=8, timeframe="M1", num_envs=4,
+                      ppo_horizon=8, ppo_epochs=1, ppo_minibatches=2,
+                      policy="mlp", rollout_env_kernel=mode,
+                      strategy_plugin="direct_fixed_sltp",
+                      slippage=1e-4, commission=2e-5)
+        env = Environment(config, dataset=MarketDataset(_df(), config))
+        return PPOTrainer(env, ppo_config_from(config))
+
+    t_xla, t_ker = trainer("off"), trainer("interpret")
+    s_xla, m_xla = t_xla.train_step(t_xla.init_state(0))
+    s_ker, m_ker = t_ker.train_step(t_ker.init_state(0))
+    _tree_equal(s_xla.params, s_ker.params, "params after train step")
+    _tree_equal(s_xla.env_states, s_ker.env_states, "env states")
+    np.testing.assert_array_equal(
+        np.asarray(m_xla["mean_reward"]), np.asarray(m_ker["mean_reward"])
+    )
+
+
+def test_env_kernel_knob_validation():
+    from gymfx_tpu.core.types import make_env_config
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, rollout_env_kernel="sideways")
+    with pytest.raises(ValueError, match="rollout_env_kernel"):
+        make_env_config(config, n_bars=64)
+
+    # honor-or-reject: configs the packed-scalar kernels cannot
+    # reproduce bitwise fail loudly instead of silently degrading
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, rollout_env_kernel="on", venue="lob")
+    with pytest.raises(ValueError, match="venue"):
+        make_env_config(config, n_bars=64)
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, rollout_env_kernel="on",
+                  reward_plugin="sharpe_reward")
+    with pytest.raises(ValueError, match="sharpe"):
+        make_env_config(config, n_bars=64)
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, rollout_env_kernel="on",
+                  compute_dtype="float64")
+    with pytest.raises(ValueError, match="float32"):
+        make_env_config(config, n_bars=64)

@@ -1,0 +1,178 @@
+"""Exact int32 parity for the sort-free pallas LOB matcher (r10).
+
+``ops/lob_match.py`` re-derives every half-book primitive of
+``lob/book.py`` (argsort price-time walk, stable compaction, scatter
+rest/cancel, lax.switch dispatch) in sort-free dense algebra so the
+stream runs as one pallas program per book.  All quantities are
+integer lots / tick prices, so parity is EXACT equality — no
+tolerance — message-for-message across flow scenarios, adversarial
+hand-built streams, capacity overflow, and agent maker fills.  Runs in
+pallas interpret mode (CPU CI), the test_rollout_obs_kernel.py
+pattern.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gymfx_tpu.lob.book import (
+    AGENT_OID,
+    MSG_ADD,
+    MSG_CANCEL,
+    MSG_MARKET,
+    MSG_NOOP,
+    BookState,
+    Messages,
+    empty_book,
+    process_stream,
+)
+from gymfx_tpu.lob.flow import random_message_streams
+from gymfx_tpu.lob.scenarios import scenario_flow_params
+from gymfx_tpu.ops.lob_match import fused_process_stream, process_stream_dense
+
+
+def _assert_same(ref, got, label):
+    for name, r, g in zip(
+        (*BookState._fields,), (*ref[0],), (*got[0],)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(r), np.asarray(g), err_msg=f"{label}: book.{name}"
+        )
+    for name, r, g in zip(ref[1]._fields, (*ref[1],), (*got[1],)):
+        np.testing.assert_array_equal(
+            np.asarray(r), np.asarray(g), err_msg=f"{label}: fill.{name}"
+        )
+
+
+def _msgs(rows):
+    cols = np.array(rows, np.int32).T
+    return Messages(*(jnp.asarray(c) for c in cols))
+
+
+@pytest.mark.parametrize(
+    "scenario", ["lob_calm", "lob_trend", "lob_volatile", "lob_thin",
+                 "lob_flash_crash"]
+)
+def test_flow_stream_parity_vmapped(scenario):
+    """Random flow streams from every scenario preset, vmapped over
+    books — the bench.py --lob workload shape."""
+    fp = scenario_flow_params(scenario)
+    msgs = random_message_streams(jax.random.PRNGKey(17), 8, 48, fp)
+    book = empty_book(16, 4)
+    ref = jax.vmap(lambda m: process_stream(book, m))(msgs)
+    dense = jax.vmap(lambda m: process_stream_dense(book, m))(msgs)
+    _assert_same(ref, dense, f"{scenario}: dense-XLA")
+    ker = jax.vmap(
+        lambda m: fused_process_stream(book, m, interpret=True)
+    )(msgs)
+    _assert_same(ref, ker, f"{scenario}: pallas")
+
+
+def test_adversarial_stream_parity():
+    """Hand-built edge cases: crossing adds (price improvement),
+    partial fills, cancels (live, dead, and oid 0), market overflow
+    past the book, noops, and out-of-range kinds (clip to market)."""
+    rows = [
+        # kind, side, price, qty, oid
+        (MSG_ADD, -1, 105, 5, 1),      # seed asks
+        (MSG_ADD, -1, 103, 3, 2),
+        (MSG_ADD, -1, 103, 2, 3),      # queue behind oid 2
+        (MSG_ADD, +1, 100, 4, 4),      # seed bids
+        (MSG_ADD, +1, 98, 6, 5),
+        (MSG_NOOP, +1, 0, 0, 0),
+        (MSG_ADD, +1, 104, 4, 6),      # crossing buy: fills 103s, rests 104
+        (MSG_MARKET, -1, 0, 3, 0),     # sell into bids (hits 104 then 100)
+        (MSG_CANCEL, -1, 0, 5, 1),     # cancel ask oid 1
+        (MSG_CANCEL, -1, 0, 5, 1),     # cancel again: dead target
+        (MSG_CANCEL, +1, 0, 0, 0),     # oid 0: never matches
+        (MSG_MARKET, +1, 0, 50, 0),    # buy overflow: drains the asks
+        (7, +1, 0, 2, 0),              # out-of-range kind clips to MARKET
+        (-2, -1, 99, 9, 9),            # negative kind clips to NOOP
+        (MSG_ADD, +1, 101, 0, 7),      # zero-qty add rests nothing
+    ]
+    book = empty_book(6, 2)
+    m = _msgs(rows)
+    ref = process_stream(book, m)
+    _assert_same(ref, process_stream_dense(book, m), "dense-XLA")
+    _assert_same(
+        ref, fused_process_stream(book, m, interpret=True), "pallas"
+    )
+
+
+def test_capacity_overflow_parity():
+    """Fixed capacity drops: more price levels than the book holds and
+    deeper queues than the slots hold — rested_qty must agree."""
+    rows = [(MSG_ADD, +1, 90 + i, 1, 10 + i) for i in range(8)]
+    rows += [(MSG_ADD, +1, 90, 1, 30 + i) for i in range(5)]
+    book = empty_book(3, 2)
+    m = _msgs(rows)
+    ref = process_stream(book, m)
+    _assert_same(
+        ref, fused_process_stream(book, m, interpret=True), "pallas"
+    )
+    assert int(jnp.sum(ref[1].rested_qty)) < len(rows)  # drops happened
+
+
+def test_agent_maker_fills_parity():
+    """An AGENT_OID resting order filled by flow takers — the
+    agent_qty/agent_value stats drive the venue's TP accounting."""
+    rows = [
+        (MSG_ADD, -1, 110, 4, AGENT_OID),   # agent TP rests on asks
+        (MSG_ADD, -1, 110, 2, 41),          # flow queues behind it
+        (MSG_MARKET, +1, 0, 3, 0),          # taker partially fills agent
+        (MSG_MARKET, +1, 0, 5, 0),          # drains the level
+    ]
+    book = empty_book(4, 3)
+    m = _msgs(rows)
+    ref = process_stream(book, m)
+    got = fused_process_stream(book, m, interpret=True)
+    _assert_same(ref, got, "agent")
+    assert int(jnp.sum(got[1].agent_qty)) == 4
+
+
+def test_lob_venue_rollout_bitwise_with_kernel():
+    """Full LOB-venue env rollout with lob_match_kernel=interpret vs
+    off: the seed stream routes through the pallas matcher, so final
+    state and trajectory must be bitwise identical."""
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core.rollout import random_driver, rollout
+    from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.data.feed import MarketDataset
+
+    from helpers import make_df
+
+    rng_np = np.random.default_rng(5)
+    closes = 1.1 * np.exp(np.cumsum(rng_np.normal(0, 3e-4, 120)))
+    spread = np.abs(rng_np.normal(0, 2e-4, 120)) + 5e-5
+    df = make_df(closes, highs=closes + spread, lows=closes - spread)
+
+    def run(mode):
+        config = dict(DEFAULT_VALUES)
+        config.update(window_size=8, timeframe="M1", venue="lob",
+                      strategy_plugin="direct_fixed_sltp",
+                      lob_match_kernel=mode)
+        env = Environment(config, dataset=MarketDataset(df, config))
+        return rollout(
+            env.cfg, env.params, env.data, random_driver(), 24,
+            jax.random.PRNGKey(11),
+        )
+
+    st_off, tr_off = run("off")
+    st_ker, tr_ker = run("interpret")
+    for i, (a, b) in enumerate(
+        zip(jax.tree.leaves((st_off, tr_off)),
+            jax.tree.leaves((st_ker, tr_ker)))
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"leaf {i}"
+        )
+
+
+def test_lob_match_knob_validation():
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.core.types import make_env_config
+
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, lob_match_kernel="sometimes")
+    with pytest.raises(ValueError, match="lob_match_kernel"):
+        make_env_config(config, n_bars=64)

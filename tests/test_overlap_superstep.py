@@ -1,0 +1,162 @@
+"""Rollout/update overlap driver + update-chain remat (r10).
+
+``make_train_many_overlapped`` restructures the superstep so iteration
+i's rollout is issued in the SAME dispatch as iteration i-1's update:
+the scheduler can run env-step kernels concurrently with the update
+GEMMs instead of serializing the two phases.  The price is documented
+semantics drift at k>1 (rollouts act on one-update-stale params — the
+V-trace regime IMPALA already corrects for), so the contract under
+test is:
+
+* k=1 is BITWISE identical to the sequential driver (no overlap body
+  runs — prologue rollout + epilogue update is exactly train_step);
+* k>1 runs, stacks metrics on a leading (k,) axis, stays finite, and
+  actually learns (params move);
+* ``ppo_update_remat`` recomputes the update forward pass instead of
+  storing activations — same math, so the updated params must match
+  the no-remat twin;
+* both knobs default off.
+"""
+import jax
+import numpy as np
+import pytest
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import MarketDataset
+
+from helpers import uptrend_df
+
+
+def _env(**over):
+    config = dict(DEFAULT_VALUES)
+    config.update(window_size=8, timeframe="M1", num_envs=4, ppo_horizon=16,
+                  ppo_epochs=2, ppo_minibatches=2,
+                  policy_kwargs={"hidden": [16, 16]})
+    config.update(over)
+    return Environment(config, dataset=MarketDataset(uptrend_df(120), config)), config
+
+
+def _ppo(**over):
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    env, config = _env(**over)
+    return PPOTrainer(env, ppo_config_from(config))
+
+
+def _impala(**over):
+    from gymfx_tpu.train.impala import ImpalaTrainer, impala_config_from
+
+    over.setdefault("impala_unroll", 16)
+    over.setdefault("policy", "mlp")
+    over.setdefault("policy_kwargs", {})
+    env, config = _env(**over)
+    return ImpalaTrainer(env, impala_config_from(config))
+
+
+def _assert_state_equal(a, b, what):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), what
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"{what} leaf {i}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# k=1 bitwise pin: overlapped == sequential
+# ---------------------------------------------------------------------------
+def test_ppo_overlapped_k1_bitwise_equals_sequential():
+    seq = _ppo()
+    ovl = _ppo(superstep_overlap=True)
+    assert ovl.pcfg.superstep_overlap
+    s_seq, m_seq = seq.train_many(seq.init_state(0), 1)
+    s_ovl, m_ovl = ovl.train_many(ovl.init_state(0), 1)
+    _assert_state_equal(s_seq, s_ovl, "ppo k=1 state")
+    assert set(m_seq) == set(m_ovl)
+    for key in m_seq:
+        np.testing.assert_array_equal(
+            np.asarray(m_seq[key]), np.asarray(m_ovl[key]), err_msg=key
+        )
+
+
+def test_impala_overlapped_k1_bitwise_equals_sequential():
+    seq = _impala()
+    ovl = _impala(superstep_overlap=True)
+    assert ovl.icfg.superstep_overlap
+    s_seq, m_seq = seq.train_many(seq.init_state(0), 1)
+    s_ovl, m_ovl = ovl.train_many(ovl.init_state(0), 1)
+    _assert_state_equal(s_seq, s_ovl, "impala k=1 state")
+    for key in m_seq:
+        np.testing.assert_array_equal(
+            np.asarray(m_seq[key]), np.asarray(m_ovl[key]), err_msg=key
+        )
+
+
+# ---------------------------------------------------------------------------
+# k>1: runs, stacks, learns
+# ---------------------------------------------------------------------------
+def test_ppo_overlapped_k3_stacks_finite_metrics_and_learns():
+    tr = _ppo(superstep_overlap=True)
+    s0 = tr.init_state(0)
+    p0 = [np.asarray(x).copy() for x in jax.tree.leaves(s0.params)]
+    state, metrics = tr.train_many(s0, 3)
+    for key, arr in metrics.items():
+        arr = np.asarray(arr)
+        assert arr.shape[0] == 3, key
+        assert np.all(np.isfinite(arr)), key
+    moved = any(
+        not np.array_equal(a, np.asarray(b))
+        for a, b in zip(p0, jax.tree.leaves(state.params))
+    )
+    assert moved
+    for leaf in jax.tree.leaves(state.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_impala_overlapped_k3_stacks_finite_metrics():
+    tr = _impala(superstep_overlap=True)
+    state, metrics = tr.train_many(tr.init_state(0), 3)
+    for key, arr in metrics.items():
+        arr = np.asarray(arr)
+        assert arr.shape[0] == 3, key
+        assert np.all(np.isfinite(arr)), key
+    # actor params track learner params through the overlap merge
+    for leaf in jax.tree.leaves(state.actor_params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+# ---------------------------------------------------------------------------
+# update-chain remat
+# ---------------------------------------------------------------------------
+def test_ppo_update_remat_params_match_no_remat():
+    """remat trades activation memory for recompute — the same forward
+    math runs twice, so the updated params must match the plain twin."""
+    plain = _ppo()
+    remat = _ppo(ppo_update_remat=True)
+    assert remat.pcfg.update_remat
+    s_plain, m_plain = plain.train_step(plain.init_state(0))
+    s_remat, m_remat = remat.train_step(remat.init_state(0))
+    for i, (a, b) in enumerate(zip(jax.tree.leaves(s_plain.params),
+                                   jax.tree.leaves(s_remat.params))):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=1e-6,
+            err_msg=f"leaf {i}"
+        )
+    assert float(m_remat["loss"]) == pytest.approx(
+        float(m_plain["loss"]), abs=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# defaults
+# ---------------------------------------------------------------------------
+def test_overlap_and_remat_default_off():
+    from gymfx_tpu.train.impala import impala_config_from
+    from gymfx_tpu.train.ppo import ppo_config_from
+
+    config = dict(DEFAULT_VALUES, window_size=8)
+    pcfg = ppo_config_from(config)
+    assert pcfg.superstep_overlap is False
+    assert pcfg.update_remat is False
+    assert impala_config_from(config).superstep_overlap is False

@@ -107,7 +107,7 @@ def build_record(*, n_envs: int, horizon: int, iters: int,
     split = measure_phase_split(sharded, m_state, iters) \
         if measure_split else None
     if split is not None:
-        rollout_s, update_s, m_state = split
+        rollout_s, update_s, m_state, _u_flops = split
         rollout_ms = rollout_s / iters * 1e3
         update_ms = update_s / iters * 1e3
 

@@ -37,6 +37,17 @@ env JAX_PLATFORMS=cpu python tools/scenario_gate.py --quick \
     > /dev/null || gate_rc=$?
 echo "scenario gate (quick): rc=$gate_rc"
 
+# r10 MFU push: bench contract smoke with the fused env-dynamics
+# kernels in pallas interpret mode — exercises the kernel path on CPU
+# CI and pins the row (incl. overlap_ms_saved / update_gemm_frac /
+# mfu_analytic) against tools/bench_contract_schema.json
+bench_rc=0
+env JAX_PLATFORMS=cpu python bench.py --quick \
+        --rollout_env_kernel interpret \
+    | env JAX_PLATFORMS=cpu python tools/check_bench_contract.py \
+    || bench_rc=$?
+echo "bench contract (quick, rollout_env_kernel=interpret): rc=$bench_rc"
+
 # telemetry smoke + PROGRESS row (registry/http/sink are jax-free:
 # this is sub-second and runs even when the suite failed, so the row
 # records the failure too)
@@ -81,5 +92,8 @@ if [ "$rc" -ne 0 ]; then
 fi
 if [ "$gate_rc" -ne 0 ]; then
     exit "$gate_rc"
+fi
+if [ "$bench_rc" -ne 0 ]; then
+    exit "$bench_rc"
 fi
 exit "$smoke_rc"

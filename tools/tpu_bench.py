@@ -104,21 +104,19 @@ def _portfolio_trainer(n_envs: int, horizon: int, window: int = 32, **over):
 
 def _measure(trainer, n_envs: int, horizon: int, iters: int,
              split_rollout: bool = False, profile_dir=None):
-    """(steps/sec, mfu, flops, split, analytic_report) for the fused
-    train step; with ``profile_dir``, also captures one jax.profiler
-    trace of the SAME compiled executable and state (no second
-    compilation).  ``analytic_report`` is the telemetry/mfu.py slice
-    (analytic_flops_per_step / hw_flops_peak / mfu_analytic) so the
-    sweep rows carry the closed-form MFU cross-check, not just the
-    XLA cost-model number."""
-    import jax
-
+    """(steps/sec, mfu, flops, split, analytic_flops, per_step_s) for
+    the fused train step; with ``profile_dir``, also captures one
+    jax.profiler trace of the SAME compiled executable and state (no
+    second compilation).  ``analytic_flops`` is the closed-form FLOP
+    count (telemetry/mfu.py) — the caller feeds it through the shared
+    row emitter (bench_util.emit_bench_record) so every sweep row
+    carries the same analytic-MFU key block as bench.py's rows."""
     from gymfx_tpu.bench_util import measure_train_step, mfu
 
     state = trainer.init_state(0)
     dt, flops, state, step = measure_train_step(trainer, state, iters)
 
-    from gymfx_tpu.telemetry.mfu import analytic_train_step_flops, mfu_report
+    from gymfx_tpu.telemetry.mfu import analytic_train_step_flops
 
     params = (
         state.params if hasattr(state, "params") else state.learner_params
@@ -127,8 +125,6 @@ def _measure(trainer, n_envs: int, horizon: int, iters: int,
     analytic = analytic_train_step_flops(
         params, num_envs=n_envs, horizon=horizon, update_epochs=epochs,
     )
-    report = mfu_report(analytic, dt / iters, jax.devices()[0])
-    report.pop("device_memory_bytes", None)  # per-row memory is noise
 
     if profile_dir is not None:
         import jax.profiler
@@ -149,15 +145,24 @@ def _measure(trainer, n_envs: int, horizon: int, iters: int,
 
         ps = measure_phase_split(trainer, state, iters)
         if ps is not None:
-            rollout_s, update_s, state = ps
+            rollout_s, update_s, state, u_flops = ps
             split = {
                 "rollout_seconds_per_iter": rollout_s / iters,
                 "update_seconds_per_iter": update_s / iters,
             }
+            # r10: update phase's share of whole-step XLA FLOPs — the
+            # rollout/update overlap's theoretical ceiling per row
+            if u_flops and flops:
+                split["update_gemm_frac"] = round(
+                    min(1.0, u_flops / flops), 4
+                )
+
+    import jax
 
     steps = n_envs * horizon * iters
     device = jax.devices()[0]
-    return steps / dt, mfu(flops, iters, dt, device), flops, split, report
+    return (steps / dt, mfu(flops, iters, dt, device), flops, split,
+            analytic, dt / iters)
 
 
 def main() -> int:
@@ -229,7 +234,7 @@ def main() -> int:
             trainer = _impala_trainer(n_envs, hor, window)
         else:
             trainer = _single_pair_trainer(policy, n_envs, hor, window, **over)
-        sps, util, flops, split_out, analytic = _measure(
+        sps, util, flops, split_out, analytic_flops, per_step_s = _measure(
             trainer, n_envs, hor, args.iters, split_rollout=split,
             profile_dir=(
                 Path(args.profile) / f"{policy}_{n_envs}"
@@ -245,9 +250,6 @@ def main() -> int:
             "vs_baseline": round(sps / BASELINE_PER_CHIP, 3),
             "mfu": round(util, 5) if util is not None else None,
             "step_flops_xla": flops,
-            # closed-form cross-check of the cost-model MFU
-            # (gymfx_tpu/telemetry/mfu.py); null off-TPU
-            **analytic,
         }
         if policy == "portfolio_mlp":
             row["n_pairs"] = 3
@@ -263,8 +265,17 @@ def main() -> int:
             row["wall_split"] = {
                 k: round(v, 5) for k, v in split_out.items()
             }
+        # shared row emitter (r10): appends the analytic-MFU key block
+        # (closed-form cross-check of the cost-model MFU; null off-TPU)
+        # and prints the row — the same path bench.py's rows go through
+        from gymfx_tpu.bench_util import emit_bench_record
+
+        emit_bench_record(
+            row, analytic_flops=analytic_flops, step_time_s=per_step_s,
+            device=device,
+        )
+        row.pop("device_memory_bytes", None)  # per-row memory is noise
         rows.append(row)
-        print(json.dumps(row), flush=True)
         del trainer
 
     # auto-derived analysis: explain batch-width rollovers from the
