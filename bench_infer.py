@@ -258,6 +258,9 @@ def main() -> None:
     deadline_miss_rate = outcomes["deadline_miss"] / submitted
 
     chips = max(1, jax.local_device_count())
+    dev = jax.local_devices()[0]
+    platform = str(getattr(dev, "platform", "unknown"))
+    device_kind = str(getattr(dev, "device_kind", platform))
     print(
         json.dumps(
             {
@@ -283,6 +286,11 @@ def main() -> None:
                 # serving SLO trio under the scripted overload scenario
                 "shed_rate": round(shed_rate, 4),
                 "deadline_miss_rate": round(deadline_miss_rate, 4),
+                # comparability stamp the bench sentinel gates on
+                # (tools/bench_sentinel.py): CPU rows are proxies
+                "platform": platform,
+                "device_kind": device_kind,
+                "comparable": platform not in ("cpu", "unknown"),
                 "overload": {
                     "fault_profile": profile_spec,
                     "submitted": submitted,

@@ -154,6 +154,29 @@ def measure_phase_split(trainer: Any, state: Any, iters: int):
     return rollout_s, update_s, state, u_flops
 
 
+def stamp_comparability(record: dict, device: Any = None) -> dict:
+    """Stamp the comparability triple the bench sentinel gates on:
+    ``platform`` / ``device_kind`` (where the row was measured) and
+    ``comparable`` (False on CPU proxies unless the caller already
+    decided).  Shared by ``emit_bench_record`` and the record builders
+    that print their own contract line (tools/multichip_bench.py)."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.local_devices()[0]
+        platform = str(getattr(device, "platform", "unknown"))
+        device_kind = str(getattr(device, "device_kind", platform))
+    except Exception:
+        platform = device_kind = "unknown"
+    record.setdefault("platform", platform)
+    record.setdefault("device_kind", device_kind)
+    # CPU rows are functional proxies, never trajectory anchors; any
+    # explicit caller verdict wins over the platform heuristic
+    record.setdefault("comparable", record["platform"] not in ("cpu", "unknown"))
+    return record
+
+
 def emit_bench_record(
     record: dict,
     *,
@@ -166,13 +189,31 @@ def emit_bench_record(
     telemetry/mfu.py analytic-MFU slice — analytic_flops_per_step /
     hw_flops_peak / mfu_analytic / device_memory_bytes, every key
     always present, null where the backend or workload cannot say
-    (CPU peak FLOPs; integer workloads with no FLOP model) — then
-    print the record as the single JSON contract line and return it."""
+    (CPU peak FLOPs; integer workloads with no FLOP model) — plus the
+    comparability stamp the bench sentinel gates on: ``platform`` /
+    ``device_kind`` (where the row was measured) and ``comparable``
+    (False on CPU proxies unless the caller already decided), then
+    print the record as the single JSON contract line and return it.
+    When a run ledger is active the row is also ledgered."""
     import json
 
     from gymfx_tpu.telemetry.mfu import mfu_report
 
     record.update(mfu_report(analytic_flops, step_time_s, device))
+    stamp_comparability(record, device=device)
+    try:
+        from gymfx_tpu.telemetry.ledger import get_active_ledger
+
+        ledger = get_active_ledger()
+        if ledger is not None:
+            ledger.record(
+                "bench_row", metric=record.get("metric"),
+                value=record.get("value"),
+                comparable=record.get("comparable"),
+                platform=record.get("platform"),
+            )
+    except Exception:
+        pass
     print(json.dumps(record), flush=True)
     return record
 

@@ -146,4 +146,13 @@ def parse_args(argv=None):
     parser.add_argument("--telemetry_http_port", type=int)
     parser.add_argument("--telemetry_slo_window_s", type=float)
 
+    # run forensics (docs/observability.md: ledger / compile watch /
+    # flight recorder); all off unless set
+    parser.add_argument("--telemetry_ledger", type=str)
+    parser.add_argument("--telemetry_flight_recorder_dir", type=str)
+    parser.add_argument("--telemetry_flight_recorder_k", type=int)
+    parser.add_argument(
+        "--telemetry_compile_watch", action="store_true", default=None
+    )
+
     return parser.parse_known_args(argv)

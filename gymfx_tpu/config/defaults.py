@@ -241,4 +241,21 @@ DEFAULT_VALUES = {
     # rolling window for the serving SLO gauges (shed_rate,
     # deadline_miss_rate, p99 over the last N seconds)
     "telemetry_slo_window_s": 60.0,
+
+    # ---- run forensics (ledger / compile watch / flight recorder) ----
+    # append-only schema-pinned JSONL run ledger path (lifecycle events:
+    # compiles, superstep dispatches, checkpoints, preemption,
+    # divergence, gate verdicts, bench rows); null = no ledger
+    "telemetry_ledger": None,
+    # directory for flight-recorder postmortem bundles (last-K superstep
+    # metric stacks + rng key + resilience snapshot + compile events,
+    # dumped on divergence/watchdog/preemption); null = no recorder
+    "telemetry_flight_recorder_dir": None,
+    # ring-buffer depth: how many drained superstep frames a postmortem
+    # bundle retains
+    "telemetry_flight_recorder_k": 8,
+    # install jax.monitoring compile listeners + executable
+    # fingerprinting (gymfx_compile_* metrics, silent-recompile and
+    # serve-bucket-miss detection)
+    "telemetry_compile_watch": False,
 }

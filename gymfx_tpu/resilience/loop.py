@@ -52,6 +52,8 @@ class ResilientLoop:
         max_consecutive_skips: int = 10,
         preempt_at: Optional[int] = None,
         loggers: Tuple[Any, ...] = (),
+        ledger: Any = None,
+        recorder: Any = None,
     ):
         self.steps_per_iter = int(steps_per_iter)
         self.checkpoint_dir = str(checkpoint_dir) if checkpoint_dir else None
@@ -69,6 +71,11 @@ class ResilientLoop:
         # one dispatch behind, so every abort path below must flush them
         # or the final superstep's metrics are silently dropped
         self.loggers = tuple(loggers)
+        # run-forensics taps (both optional, both never-raises by
+        # contract): the ledger records lifecycle events, the flight
+        # recorder dumps its postmortem bundle on the abort paths
+        self.ledger = ledger
+        self.recorder = recorder
         self.last_checkpoint_step: Optional[int] = None
         # (it_start, k, guard metrics) — scalars for k == 1, stacked
         # (k,) arrays for a fused superstep
@@ -93,6 +100,8 @@ class ResilientLoop:
             metadata=self.checkpoint_metadata, params=params,
         )
         self.last_checkpoint_step = step
+        if self.ledger is not None:
+            self.ledger.record("checkpoint_write", step=int(step))
 
     def _check_pending(self, state_fn: StateFn) -> None:
         if self.monitor is None or self._pending is None:
@@ -126,6 +135,11 @@ class ResilientLoop:
                     self.step_offset + (it_start + k) * self.steps_per_iter,
                 )
             self._flush_loggers()
+            if self.ledger is not None:
+                self.ledger.record("divergence", it=int(it_start + k))
+            if self.recorder is not None:
+                self.recorder.dump("divergence",
+                                   extra={"it": int(it_start + k)})
             raise
 
     # ------------------------------------------------------------------
@@ -144,6 +158,9 @@ class ResilientLoop:
         the first boundary reaching ``preempt_at``.
         """
         it_end = it_start + k
+        if self.ledger is not None:
+            self.ledger.record("superstep_dispatch",
+                               it_start=int(it_start), k=int(k))
         if self.monitor is not None:
             self._check_pending(state_fn)
             self._pending = (
@@ -159,6 +176,10 @@ class ResilientLoop:
             self._save(state_fn, self.step_offset + it_end * self.steps_per_iter)
         if self.preempt_at is not None and it_end >= self.preempt_at:
             self._flush_loggers()
+            if self.ledger is not None:
+                self.ledger.record("preemption", it=int(it_end))
+            if self.recorder is not None:
+                self.recorder.dump("preemption", extra={"it": int(it_end)})
             raise SimulatedPreemptionError(it_end)
 
     def after_step(self, it: int, metrics: Dict[str, Any],

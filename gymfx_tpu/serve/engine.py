@@ -37,6 +37,7 @@ Two in-graph batching modes (``batch_mode``):
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -181,6 +182,10 @@ class InferenceEngine:
         # owns the one dispatch thread in the serving topology anyway)
         self._lock = threading.Lock()
         self.late_compiles = 0  # compiles after boot — a warm engine has 0
+        # compile-watch hook: called as on_compile(bucket, duration_s,
+        # late) after every bucket compile (CompileWatch.watch_engine
+        # attaches it; None costs nothing)
+        self.on_compile = None
         if warmup:
             self.warmup()
 
@@ -212,13 +217,17 @@ class InferenceEngine:
         for bucket in self.buckets:
             if bucket in self._compiled:
                 continue
+            t0 = time.perf_counter()
             exe = self._fwd.lower(
                 self.params, *self._zero_batch(bucket)
             ).compile()
+            compile_s = time.perf_counter() - t0
             # one throwaway execution per bucket: boot absorbs every
             # first-call cost, the serving path never does
             exe(self.params, *self._zero_batch(bucket))
             self._compiled[bucket] = exe
+            if self.on_compile is not None:
+                self.on_compile(bucket, compile_s, False)
 
     @property
     def executable_count(self) -> int:
@@ -241,9 +250,12 @@ class InferenceEngine:
         if exe is None:
             # never hit after warmup() with a covering ladder; counted so
             # the zero-compiles-after-boot contract is testable
+            t0 = time.perf_counter()
             exe = self._fwd.lower(self.params, obs_pad, carry_pad).compile()
             self._compiled[bucket] = exe
             self.late_compiles += 1
+            if self.on_compile is not None:
+                self.on_compile(bucket, time.perf_counter() - t0, True)
         return exe(self.params, obs_pad, carry_pad)
 
     def decide_batch(self, obs_batch: Any, carries: Any = None):

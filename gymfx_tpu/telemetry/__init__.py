@@ -16,6 +16,14 @@ Config keys (config/defaults.py, all default off):
   ``telemetry_http_port``     /metrics + /healthz endpoint; 0 binds an
                               ephemeral port (serving only)
   ``telemetry_slo_window_s``  rolling SLO window length (serving)
+
+Run-forensics knobs (same off-by-default contract):
+
+  ``telemetry_ledger``              append-only JSONL run-ledger path
+  ``telemetry_flight_recorder_dir`` postmortem bundle directory
+  ``telemetry_flight_recorder_k``   frames the ring buffer retains
+  ``telemetry_compile_watch``       jax.monitoring compile listeners +
+                                    executable fingerprinting
 """
 from __future__ import annotations
 
@@ -34,27 +42,47 @@ from gymfx_tpu.telemetry.registry import (  # noqa: F401
     register_resilience,
     resilience_snapshot,
 )
+from gymfx_tpu.telemetry.compile_watch import CompileWatch  # noqa: F401
+from gymfx_tpu.telemetry.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    validate_postmortem,
+)
+from gymfx_tpu.telemetry.ledger import (  # noqa: F401
+    RunLedger,
+    config_digest,
+    get_active_ledger,
+    set_active_ledger,
+    validate_ledger,
+)
 from gymfx_tpu.telemetry.sink import JsonlSink, append_jsonl  # noqa: F401
 from gymfx_tpu.telemetry.slo import SLOWindow  # noqa: F401
 from gymfx_tpu.telemetry.spans import Tracer, null_tracer  # noqa: F401
 
 __all__ = [
+    "CompileWatch",
     "Counter",
     "DelayedLogger",
     "DeviceMetricStream",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
+    "RunLedger",
     "SLOWindow",
     "Telemetry",
     "Tracer",
     "append_jsonl",
+    "config_digest",
+    "get_active_ledger",
     "global_registry",
     "null_tracer",
     "register_resilience",
     "resilience_snapshot",
+    "set_active_ledger",
     "telemetry_from_config",
+    "validate_ledger",
+    "validate_postmortem",
 ]
 
 
@@ -69,12 +97,18 @@ class Telemetry:
         tracer: Optional[Tracer] = None,
         slo_window_s: float = 60.0,
         http_port: Optional[int] = None,
+        ledger: Optional[RunLedger] = None,
+        recorder: Optional[FlightRecorder] = None,
+        compile_watch: Optional[CompileWatch] = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.sink = sink
         self.tracer = tracer if tracer is not None else null_tracer()
         self.slo_window_s = float(slo_window_s)
         self.http_port = None if http_port is None else int(http_port)
+        self.ledger = ledger
+        self.recorder = recorder
+        self.compile_watch = compile_watch
         self._server = None
 
     # -- construction helpers the layers share -------------------------
@@ -86,6 +120,7 @@ class Telemetry:
         return DeviceMetricStream(
             tag, iters=iters, log_every=log_every, registry=self.registry,
             sink=self.sink, steps_per_iter=steps_per_iter,
+            recorder=self.recorder,
         )
 
     def serve_instruments(self, name: str = "serve"):
@@ -117,6 +152,12 @@ class Telemetry:
         if self._server is not None:
             self._server.close()
             self._server = None
+        if self.compile_watch is not None:
+            self.compile_watch.uninstall()
+        if self.ledger is not None:
+            if get_active_ledger() is self.ledger:
+                set_active_ledger(None)
+            self.ledger.close()
         if self.sink is not None:
             self.sink.close()
 
@@ -129,16 +170,44 @@ def telemetry_from_config(config: Dict[str, Any]) -> Optional[Telemetry]:
     spans = bool(config.get("telemetry_spans"))
     port = config.get("telemetry_http_port")
     port = None if port in (None, "") or int(port) < 0 else int(port)
-    if not (enabled or jsonl or spans or port is not None):
+    ledger_path = config.get("telemetry_ledger") or None
+    recorder_dir = config.get("telemetry_flight_recorder_dir") or None
+    watch = bool(config.get("telemetry_compile_watch"))
+    if not (enabled or jsonl or spans or port is not None
+            or ledger_path or recorder_dir or watch):
         return None
     registry = MetricsRegistry()
     sink = JsonlSink(str(jsonl)) if jsonl else None
     tracer = Tracer(enabled=spans, registry=registry if spans else None,
                     sink=sink if spans else None)
+    sha = config_digest(config)
+    ledger = None
+    if ledger_path:
+        ledger = RunLedger(str(ledger_path), config_sha256=sha)
+        set_active_ledger(ledger)
+    recorder = None
+    if recorder_dir:
+        recorder = FlightRecorder(
+            str(recorder_dir),
+            k=int(config.get("telemetry_flight_recorder_k", 8) or 8),
+            config_sha256=sha,
+            ledger=ledger,
+        )
+        recorder.set_resilience_source(
+            lambda: resilience_snapshot(registry)
+        )
+    compile_watch = None
+    if watch:
+        compile_watch = CompileWatch(
+            registry, ledger=ledger, recorder=recorder
+        ).install()
     return Telemetry(
         registry=registry,
         sink=sink,
         tracer=tracer,
         slo_window_s=float(config.get("telemetry_slo_window_s", 60.0) or 60.0),
         http_port=port,
+        ledger=ledger,
+        recorder=recorder,
+        compile_watch=compile_watch,
     )

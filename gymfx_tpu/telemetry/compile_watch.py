@@ -1,0 +1,249 @@
+"""Compile watch: jax compile events as metrics, plus executable
+fingerprinting so silent recompiles are DETECTED instead of suspected.
+
+Two complementary signal paths land in one registry + ledger:
+
+  * ``jax.monitoring`` listeners — every ``/jax/core/compile/*``
+    duration event (jaxpr trace, MLIR lowering, backend compile)
+    becomes a ``gymfx_compile_events_total`` counter tick and a
+    ``gymfx_compile_seconds`` histogram observation, and every backend
+    compile is ledgered as a ``compile_end`` event.  This path catches
+    compiles NOBODY asked for — the silent jit-cache misses the serving
+    contract ("zero late compiles") forbids.
+  * explicit program records — :meth:`CompileWatch.record_compile`
+    takes a (name, key) identity plus the lowered-HLO sha256
+    (:func:`fingerprint`), so a *recompile of a known key* (same
+    (name, shapes, donation) identity compiled again, fingerprint
+    drifted or not) is counted separately and ledgered as
+    ``recompile``.  The serving engine's boot ladder and late-compile
+    path report through :meth:`watch_engine`.
+
+``jax.monitoring`` offers registration but no per-listener removal, so
+the process installs ONE forwarding listener pair lazily and routes
+through a module-level active-watch slot; :meth:`uninstall` clears the
+slot (cheap, test-safe) rather than the global listener list.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+# compile times span trace-cache hits (~1ms) to pod-scale XLA runs
+# (minutes) — wider edges than the request-latency default
+COMPILE_BUCKETS = (
+    0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0,
+)
+
+_install_lock = threading.Lock()
+_listeners_installed = False
+_active: Optional["CompileWatch"] = None
+
+
+def fingerprint(lowered: Any) -> str:
+    """sha256 of the lowered program text — the executable identity the
+    recompile detector compares.  Accepts a ``jax.stages.Lowered`` (or
+    anything with ``as_text()``) or a plain string."""
+    text = lowered if isinstance(lowered, str) else lowered.as_text()
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def _forward_event(event: str, **kwargs: Any) -> None:
+    watch = _active
+    if watch is not None:
+        watch._on_event(event)
+
+
+def _forward_duration(event: str, duration: float, **kwargs: Any) -> None:
+    watch = _active
+    if watch is not None:
+        watch._on_duration(event, duration)
+
+
+class CompileWatch:
+    """Registry + ledger view of every compile the process performs."""
+
+    def __init__(self, registry: Any, *, ledger: Any = None,
+                 recorder: Any = None, name: str = "default"):
+        self.registry = registry
+        self.ledger = ledger
+        self.recorder = recorder
+        self.name = str(name)
+        self.events = registry.counter(
+            "gymfx_compile_events_total",
+            "jax.monitoring compile-pipeline events by stage",
+            labels=("event",),
+        )
+        self.seconds = registry.histogram(
+            "gymfx_compile_seconds",
+            "Compile-stage durations (jax.monitoring)",
+            labels=("event",),
+            buckets=COMPILE_BUCKETS,
+        )
+        self.programs = registry.counter(
+            "gymfx_compile_programs_total",
+            "Explicitly recorded program compiles by (watch, late)",
+            labels=("watch", "late"),
+        )
+        self.recompiles = registry.counter(
+            "gymfx_compile_recompiles_total",
+            "Program keys compiled MORE THAN ONCE (silent-recompile "
+            "detector)",
+            labels=("watch",),
+        )
+        self.bucket_misses = registry.counter(
+            "gymfx_serve_bucket_miss_total",
+            "Serve requests that landed outside the compiled bucket "
+            "ladder (late compile on the decision path)",
+            labels=("watch",),
+        )
+        # (name, key) -> lowered-HLO digest (or None when unavailable)
+        self._fingerprints: Dict[Tuple[str, str], Optional[str]] = {}
+        self._lock = threading.Lock()
+
+    # -- jax.monitoring forwarders -------------------------------------
+    def install(self) -> "CompileWatch":
+        """Become the process's active watch (one forwarding listener
+        pair is registered with jax.monitoring on first install)."""
+        global _listeners_installed, _active
+        with _install_lock:
+            if not _listeners_installed:
+                try:
+                    from jax import monitoring
+
+                    monitoring.register_event_listener(_forward_event)
+                    monitoring.register_event_duration_secs_listener(
+                        _forward_duration
+                    )
+                    _listeners_installed = True
+                except Exception:
+                    # no jax / an incompatible monitoring surface:
+                    # explicit record_compile/watch_engine still work
+                    pass
+            _active = self
+        return self
+
+    def uninstall(self) -> None:
+        global _active
+        with _install_lock:
+            if _active is self:
+                _active = None
+
+    def _on_event(self, event: str) -> None:
+        if "compile" not in event:
+            return
+        try:
+            self.events.inc(event=event)
+        except Exception:
+            pass
+
+    def _on_duration(self, event: str, duration: float) -> None:
+        if "compile" not in event:
+            return
+        try:
+            self.events.inc(event=event)
+            self.seconds.observe(float(duration), event=event)
+            if event.endswith("backend_compile_duration"):
+                # a real XLA compile happened in this process — ledger
+                # it even when nobody claimed it via record_compile
+                if self.ledger is not None:
+                    self.ledger.record(
+                        "compile_end", name=f"jax:{event}",
+                        duration_s=float(duration),
+                    )
+                if self.recorder is not None:
+                    self.recorder.record_compile({
+                        "kind": "compile_end", "name": f"jax:{event}",
+                        "duration_s": float(duration),
+                    })
+        except Exception:
+            pass
+
+    # -- explicit program-identity records -----------------------------
+    def record_compile(
+        self,
+        name: str,
+        *,
+        key: str = "",
+        hlo_sha256: Optional[str] = None,
+        duration_s: Optional[float] = None,
+        late: bool = False,
+    ) -> None:
+        """Record one program compile under the identity ``(name, key)``
+        (key = the shapes/donation signature the caller buckets by).  A
+        second compile of a known identity is a recompile — the silent
+        kind this watch exists to catch."""
+        ident = (str(name), str(key))
+        with self._lock:
+            seen = ident in self._fingerprints
+            self._fingerprints[ident] = hlo_sha256
+        try:
+            self.programs.inc(watch=self.name, late=str(bool(late)).lower())
+        except Exception:
+            pass
+        event = {
+            "name": str(name), "key": str(key), "hlo_sha256": hlo_sha256,
+            "duration_s": duration_s, "late": bool(late),
+        }
+        if seen:
+            try:
+                self.recompiles.inc(watch=self.name)
+            except Exception:
+                pass
+            if self.ledger is not None:
+                self.ledger.record("recompile", **event)
+        else:
+            if self.ledger is not None:
+                self.ledger.record("compile_begin", name=str(name),
+                                   key=str(key), late=bool(late))
+                self.ledger.record(
+                    "compile_end", name=str(name), key=str(key),
+                    duration_s=duration_s, hlo_sha256=hlo_sha256,
+                    late=bool(late),
+                )
+        if self.recorder is not None:
+            self.recorder.record_compile({"kind": "compile", **event})
+
+    @property
+    def fingerprint_count(self) -> int:
+        with self._lock:
+            return len(self._fingerprints)
+
+    # -- serving-engine binding ----------------------------------------
+    def watch_engine(self, engine: Any, *, name: str = "serve") -> None:
+        """Attach to an :class:`~gymfx_tpu.serve.engine.InferenceEngine`:
+        future bucket compiles (boot ladder via ``warmup()`` and late
+        compiles on the decision path) report through the engine's
+        ``on_compile`` hook; buckets ALREADY compiled at attach time are
+        recorded retroactively (no duration — boot happened before the
+        watch existed).  Late compiles additionally count as serve
+        bucket misses and ledger a ``serve_bucket_miss`` event."""
+        for bucket in sorted(getattr(engine, "_compiled", {})):
+            self.record_compile(
+                f"{name}_forward", key=f"bucket={bucket}", late=False,
+            )
+
+        def on_compile(bucket: int, duration_s: Optional[float],
+                       late: bool) -> None:
+            self.record_compile(
+                f"{name}_forward", key=f"bucket={bucket}",
+                duration_s=duration_s, late=late,
+            )
+            if late:
+                try:
+                    self.bucket_misses.inc(watch=self.name)
+                except Exception:
+                    pass
+                if self.ledger is not None:
+                    self.ledger.record("serve_bucket_miss", bucket=int(bucket))
+
+        engine.on_compile = on_compile
+
+
+def timed(fn):
+    """``(result, seconds)`` of ``fn()`` — the engine compile sites use
+    it so the hook gets a real duration."""
+    t0 = time.perf_counter()
+    result = fn()
+    return result, time.perf_counter() - t0

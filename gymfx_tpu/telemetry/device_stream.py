@@ -17,10 +17,15 @@ never inserts a hot host sync.  One drain per dispatch feeds
     counters, every other scalar (loss, entropy, grad stats) as a
     newest-value ``gymfx_train_metric`` gauge, plus iteration/env-step
     progress counters;
-  * an optional JSONL sink row per drained dispatch.
+  * an optional JSONL sink row per drained dispatch;
+  * an optional :class:`~gymfx_tpu.telemetry.flight_recorder.FlightRecorder`
+    frame (the full per-iteration stacks, riding the same single host
+    fetch) plus per-superstep device-memory watermark gauges
+    (``gymfx_device_memory_bytes{stat=...}`` from the allocator's
+    ``memory_stats()`` — a host-side query, never a device sync).
 
-With no registry/sink and ``log_every=0`` the stream holds nothing and
-the training loop is exactly the pre-telemetry one.
+With no registry/sink/recorder and ``log_every=0`` the stream holds
+nothing and the training loop is exactly the pre-telemetry one.
 """
 from __future__ import annotations
 
@@ -42,12 +47,14 @@ class DeviceMetricStream:
         sink: Any = None,
         steps_per_iter: Optional[int] = None,
         printer: Callable[[str], None] = print,
+        recorder: Any = None,
     ):
         self.tag = str(tag)
         self.every = int(log_every or 0)
         self.iters = int(iters)
         self.registry = registry
         self.sink = sink
+        self.recorder = recorder
         self.steps_per_iter = (
             None if steps_per_iter is None else int(steps_per_iter)
         )
@@ -55,7 +62,14 @@ class DeviceMetricStream:
         # (it_end, k, stacked device tree, want_print)
         self._held: Optional[Tuple[int, int, Dict[str, Any], bool]] = None
         self._counters = self._gauge = self._iters_ctr = self._steps_ctr = None
+        self._mem_gauge = None
         if registry is not None:
+            self._mem_gauge = registry.gauge(
+                "gymfx_device_memory_bytes",
+                "Device allocator watermark sampled per drained "
+                "superstep (memory_stats)",
+                labels=("algo", "stat"),
+            )
             self._counters = {
                 key: registry.counter(
                     f"gymfx_train_{key}_total",
@@ -92,7 +106,8 @@ class DeviceMetricStream:
             self.every
             and (it_start + k) // self.every > it_start // self.every
         )
-        if want_print or self.registry is not None or self.sink is not None:
+        if (want_print or self.registry is not None
+                or self.sink is not None or self.recorder is not None):
             self._held = (it_start + k, k, metrics, want_print)
 
     def finish(self) -> None:
@@ -127,6 +142,11 @@ class DeviceMetricStream:
             self._printer(
                 f"[{self.tag}] iter {it_end}/{self.iters} {newest}"
             )
+        if self.recorder is not None:
+            self.recorder.record_frame(
+                it_end, k,
+                {key: arr.tolist() for key, arr in host.items()},
+            )
         if self.registry is not None:
             for key, ctr in self._counters.items():
                 arr = host.get(key)
@@ -140,6 +160,14 @@ class DeviceMetricStream:
                 self._steps_ctr.inc(
                     float(k * self.steps_per_iter), algo=self.tag
                 )
+            from gymfx_tpu.telemetry.mfu import device_memory_watermarks
+
+            watermarks = device_memory_watermarks()
+            if watermarks:
+                for stat, value in watermarks.items():
+                    self._mem_gauge.set(
+                        float(value), algo=self.tag, stat=stat
+                    )
         if self.sink is not None:
             self.sink.append({
                 "kind": "train_metrics",

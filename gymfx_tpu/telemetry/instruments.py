@@ -163,6 +163,17 @@ class ServeInstruments:
                 labels=("batcher",),
             )
             cap.set(float(batcher.max_queue), batcher=self.name)
+        engine = getattr(batcher, "engine", None)
+        if engine is not None and hasattr(engine, "late_compiles"):
+            late = self.registry.gauge(
+                "gymfx_serve_late_compiles_total",
+                "Engine compiles AFTER boot (a warm serving path scrapes "
+                "0 forever; monotonic, read at scrape time)",
+                labels=("batcher",),
+            )
+            late.set_function(
+                lambda e=engine: float(e.late_compiles), batcher=self.name
+            )
         if batcher.breaker is not None:
             from gymfx_tpu.telemetry.registry import register_resilience
 

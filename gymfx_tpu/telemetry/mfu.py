@@ -97,6 +97,38 @@ def device_memory_bytes(device: Any = None) -> Optional[int]:
     return None if raw is None else int(raw)
 
 
+# the allocator stats worth a per-superstep gauge; peak_bytes_in_use is
+# the watermark the OOM postmortems actually want
+MEMORY_WATERMARK_KEYS = (
+    "bytes_in_use",
+    "peak_bytes_in_use",
+    "bytes_limit",
+    "largest_alloc_size",
+)
+
+
+def device_memory_watermarks(device: Any = None) -> Optional[dict]:
+    """The allocator watermark slice of ``device.memory_stats()`` as
+    ``{key: int}``, or None where the backend exposes no stats (CPU).
+    A pure host-side allocator query — safe on the drain cadence, it
+    never syncs the device."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {
+        key: int(stats[key]) for key in MEMORY_WATERMARK_KEYS
+        if stats.get(key) is not None
+    }
+    return out or None
+
+
 def mfu_report(
     flops_per_step: Optional[float],
     step_time_s: Optional[float],

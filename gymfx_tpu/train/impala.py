@@ -520,7 +520,13 @@ class ImpalaTrainer:
             ),
             preempt_at=preempt_at,
             loggers=(logger,),
+            ledger=telemetry.ledger if telemetry is not None else None,
+            recorder=telemetry.recorder if telemetry is not None else None,
         )
+        if telemetry is not None and telemetry.recorder is not None:
+            # the closure reads the rebound local, so a postmortem dump
+            # captures the rng key the run DIED with, not the seed key
+            telemetry.recorder.set_rng_source(lambda: state.rng)
         if telemetry is not None and hooks.monitor is not None:
             from gymfx_tpu.telemetry import register_resilience
 
@@ -593,28 +599,41 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.telemetry import telemetry_from_config
 
     telemetry = telemetry_from_config(config)
-    state, train_metrics = trainer.train(
-        total, seed=int(config.get("seed", 0) or 0),
-        initial_state=resume_state, initial_params=resume_params,
-        checkpoint_dir=config.get("checkpoint_dir"),
-        checkpoint_every=int(config.get("checkpoint_every", 0) or 0),
-        step_offset=resume_step,
-        checkpoint_metadata={"policy": icfg.policy,
-                             "policy_kwargs": dict(icfg.policy_kwargs)},
-        max_consecutive_skips=int(
-            config.get("guard_max_consecutive_skips", 10) or 0
-        ),
-        supersteps_per_dispatch=int(
-            config.get("supersteps_per_dispatch", 1) or 1
-        ),
-        preempt_at=profile.get("preempt_at"),
-        telemetry=telemetry,
-    )
+    if telemetry is not None and telemetry.ledger is not None and (
+            resume_state is not None or resume_params is not None):
+        telemetry.ledger.record("checkpoint_restore", step=int(resume_step))
+    try:
+        state, train_metrics = trainer.train(
+            total, seed=int(config.get("seed", 0) or 0),
+            initial_state=resume_state, initial_params=resume_params,
+            checkpoint_dir=config.get("checkpoint_dir"),
+            checkpoint_every=int(config.get("checkpoint_every", 0) or 0),
+            step_offset=resume_step,
+            checkpoint_metadata={"policy": icfg.policy,
+                                 "policy_kwargs": dict(icfg.policy_kwargs)},
+            max_consecutive_skips=int(
+                config.get("guard_max_consecutive_skips", 10) or 0
+            ),
+            supersteps_per_dispatch=int(
+                config.get("supersteps_per_dispatch", 1) or 1
+            ),
+            preempt_at=profile.get("preempt_at"),
+            telemetry=telemetry,
+        )
+    except BaseException:
+        # abort paths (preemption drill, divergence) still seal the run
+        # ledger with its run_end row — the postmortem bundle was
+        # already dumped by ResilientLoop before the raise
+        if telemetry is not None:
+            telemetry.close()
+        raise
     if telemetry is not None and telemetry.sink is not None:
         telemetry.sink.append({
             "kind": "metrics_snapshot", "algo": "impala",
             "registry": telemetry.registry.snapshot(),
         })
+    if telemetry is not None:
+        telemetry.close()
 
     # greedy eval through the shared evaluate() machinery
     from gymfx_tpu.train import ppo as ppo_mod

@@ -665,7 +665,13 @@ class PPOTrainer:
             ),
             preempt_at=preempt_at,
             loggers=(logger,),
+            ledger=telemetry.ledger if telemetry is not None else None,
+            recorder=telemetry.recorder if telemetry is not None else None,
         )
+        if telemetry is not None and telemetry.recorder is not None:
+            # the closure reads the rebound local, so a postmortem dump
+            # captures the rng key the run DIED with, not the seed key
+            telemetry.recorder.set_rng_source(lambda: state.rng)
         if telemetry is not None and hooks.monitor is not None:
             from gymfx_tpu.telemetry import register_resilience
 
@@ -838,27 +844,40 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.telemetry import telemetry_from_config
 
     telemetry = telemetry_from_config(config)
-    state, train_metrics = trainer.train(
-        total, seed=int(config.get("seed", 0) or 0),
-        initial_params=resume_params, initial_state=resume_state,
-        checkpoint_dir=config.get("checkpoint_dir"),
-        checkpoint_every=int(config.get("checkpoint_every", 0) or 0),
-        step_offset=resume_step,
-        checkpoint_metadata=ckpt_meta,
-        max_consecutive_skips=int(
-            config.get("guard_max_consecutive_skips", 10) or 0
-        ),
-        preempt_at=profile.get("preempt_at"),
-        supersteps_per_dispatch=int(
-            config.get("supersteps_per_dispatch", 1) or 1
-        ),
-        telemetry=telemetry,
-    )
+    if telemetry is not None and telemetry.ledger is not None and (
+            resume_state is not None or resume_params is not None):
+        telemetry.ledger.record("checkpoint_restore", step=int(resume_step))
+    try:
+        state, train_metrics = trainer.train(
+            total, seed=int(config.get("seed", 0) or 0),
+            initial_params=resume_params, initial_state=resume_state,
+            checkpoint_dir=config.get("checkpoint_dir"),
+            checkpoint_every=int(config.get("checkpoint_every", 0) or 0),
+            step_offset=resume_step,
+            checkpoint_metadata=ckpt_meta,
+            max_consecutive_skips=int(
+                config.get("guard_max_consecutive_skips", 10) or 0
+            ),
+            preempt_at=profile.get("preempt_at"),
+            supersteps_per_dispatch=int(
+                config.get("supersteps_per_dispatch", 1) or 1
+            ),
+            telemetry=telemetry,
+        )
+    except BaseException:
+        # abort paths (preemption drill, divergence) still seal the run
+        # ledger with its run_end row — the postmortem bundle was
+        # already dumped by ResilientLoop before the raise
+        if telemetry is not None:
+            telemetry.close()
+        raise
     if telemetry is not None and telemetry.sink is not None:
         telemetry.sink.append({
             "kind": "metrics_snapshot", "algo": "ppo",
             "registry": telemetry.registry.snapshot(),
         })
+    if telemetry is not None:
+        telemetry.close()
 
     # out-of-sample: greedy episode on bars the agent never trained on
     # (BASELINE metric 2 made scientifically meaningful); the in-sample

@@ -477,3 +477,96 @@ def test_batcher_path_shed_maps_to_shed_fallback(monkeypatch):
     assert int(d.action) == 0 and order is None
     rec = svc.decision_records[-1]
     assert rec.source == "fallback" and rec.reason == "shed"
+
+
+# ----------------------------------------------------------------------
+# /healthz degraded-state visibility: an operator watching the endpoint
+# must SEE each brownout mode, not infer it from missing traffic
+
+
+def _healthz(health_fn):
+    import json
+
+    from gymfx_tpu.telemetry import MetricsRegistry
+    from gymfx_tpu.telemetry.http import TelemetryServer, scrape
+
+    with TelemetryServer(
+        MetricsRegistry(), health_fn=health_fn, port=0
+    ) as server:
+        return json.loads(scrape(server.url + "/healthz"))
+
+
+def test_healthz_shows_open_breaker(monkeypatch):
+    svc, _t, closes = _service(
+        serve_fallback="hold",
+        serve_breaker_threshold=1,
+        serve_breaker_recovery_s=60.0,
+    )
+
+    def boom(row, carry=None):
+        raise RuntimeError("engine fell over")
+
+    monkeypatch.setattr(svc.engine, "decide", boom)
+    svc.decide_and_route(float(closes[0]))  # trips the breaker
+    svc.decide_and_route(float(closes[1]))  # rides the open breaker
+    payload = _healthz(svc.health)
+    assert payload["breaker_state"] == "open"
+    assert payload["last_fallback_reason"] == "breaker_open"
+    assert payload["fallback_count"] == 2
+    assert payload["decisions"] == 2
+
+
+def test_healthz_shows_stale_feed():
+    clock = {"t": 100.0}
+    svc, _t, closes = _service(
+        serve_fallback="hold", feed_stale_after_s=5.0
+    )
+    svc._clock = lambda: clock["t"]
+    svc._last_bar_at = None
+    svc.decide_and_route(float(closes[0]))
+    clock["t"] += 60.0  # the feed gapped
+    svc.decide_and_route(float(closes[1]))
+    payload = _healthz(svc.health)
+    assert payload["feed_stale_count"] == 1
+    assert payload["last_fallback_reason"] == "stale_feed"
+    # the stale bar itself reset the watchdog clock: age restarts at 0
+    assert payload["feed_age_s"] == 0.0
+    # the service itself still answers (degraded, not dead)
+    assert payload["status"] == "ok"
+
+
+def test_healthz_shows_service_level_shed():
+    svc, _t, closes = _service(serve_fallback="hold")
+
+    class AlwaysShedBatcher:
+        def submit(self, row, carry=None, *, deadline_ms=None):
+            raise ShedError("queue full", reason="queue_full")
+
+        def health(self):
+            return {"queue_depth": 7, "shed_count": 3}
+
+    svc.batcher = AlwaysShedBatcher()
+    svc.decide_and_route(float(closes[0]))
+    payload = _healthz(svc.health)
+    assert payload["last_fallback_reason"] == "shed"
+    assert payload["fallback_count"] == 1
+    # the batcher's own view rides along in the same payload
+    assert payload["batcher"]["shed_count"] == 3
+    assert payload["batcher"]["queue_depth"] == 7
+
+
+def test_healthz_shows_queue_saturated_batcher():
+    eng, mb, f0 = _blocked_batcher(max_queue=1)
+    try:
+        f1 = mb.submit(_rows(1, seed=3)[0])  # fills the queue
+        with pytest.raises(ShedError):
+            mb.submit(_rows(1, seed=4)[0])  # saturated: shed
+        payload = _healthz(mb.health)
+        assert payload["queue_depth"] == 1
+        assert payload["shed_count"] == 1
+        assert payload["breaker_state"] is None
+    finally:
+        eng.gate.set()
+        for f in (f0, f1):
+            assert isinstance(f.result(timeout=30), Decision)
+        mb.close()

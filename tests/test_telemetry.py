@@ -656,3 +656,153 @@ def test_uninstrumented_batcher_has_no_instrument_hooks():
         assert "slo" not in mb.health()
     finally:
         mb.close()
+
+
+# ----------------------------------------------------------------------
+# run-forensics knobs: off path stays None, each knob builds its piece
+
+
+def test_telemetry_from_config_forensics_knobs_off_path_is_none():
+    from gymfx_tpu.config import DEFAULT_VALUES
+
+    cfg = dict(DEFAULT_VALUES)
+    # the forensics knobs ship in the defaults and default OFF
+    assert "telemetry_ledger" in cfg
+    assert "telemetry_flight_recorder_dir" in cfg
+    assert "telemetry_compile_watch" in cfg
+    assert telemetry_from_config(cfg) is None
+    # the ring size alone is a parameter, not a trigger
+    assert telemetry_from_config({"telemetry_flight_recorder_k": 4}) is None
+
+
+def test_telemetry_from_config_ledger_knob_builds_and_seals(tmp_path):
+    from gymfx_tpu.telemetry import get_active_ledger, validate_ledger
+
+    path = str(tmp_path / "ledger.jsonl")
+    t = telemetry_from_config({"telemetry_ledger": path})
+    assert t is not None and t.ledger is not None
+    # the process-global slot points at the run's ledger while it lives
+    assert get_active_ledger() is t.ledger
+    assert t.ledger.record("gate_verdict", verdict="pass")
+    t.close()
+    assert get_active_ledger() is None
+    assert validate_ledger(path) == []
+    from gymfx_tpu.telemetry.ledger import read_ledger
+
+    kinds = [r["kind"] for r in read_ledger(path)]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    t.close()  # idempotent: no second run_end
+    assert [r["kind"] for r in read_ledger(path)].count("run_end") == 1
+
+
+def test_telemetry_from_config_recorder_and_watch_knobs(tmp_path):
+    from gymfx_tpu.telemetry import compile_watch as cw_mod
+
+    t = telemetry_from_config({
+        "telemetry_flight_recorder_dir": str(tmp_path / "pm"),
+        "telemetry_flight_recorder_k": 3,
+        "telemetry_compile_watch": True,
+    })
+    try:
+        assert t.recorder is not None and t.recorder.k == 3
+        assert t.compile_watch is not None
+        # install() made it the process's active watch...
+        assert cw_mod._active is t.compile_watch
+        # ...and the recorder rides the trainers' device streams
+        stream = t.device_stream("ppo", iters=2)
+        assert stream.recorder is t.recorder
+    finally:
+        t.close()
+    # close() cleared the active slot: compiles no longer route here
+    assert cw_mod._active is None
+
+
+def test_device_stream_feeds_recorder_frames_on_the_drain(tmp_path):
+    from gymfx_tpu.telemetry import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path / "pm"), k=4)
+    # recorder only — no registry, no sink, no printing
+    s = DeviceMetricStream("ppo", iters=4, recorder=rec)
+    s.after_dispatch(0, 2, {"loss": np.array([0.5, 0.25])})
+    assert rec.frame_count == 0  # one dispatch behind
+    s.after_dispatch(2, 2, {"loss": np.array([0.125, 0.0625])})
+    assert rec.frame_count == 1
+    s.finish()
+    assert rec.frame_count == 2
+    path = rec.dump("manual")
+    frames = [json.loads(l) for l in open(path + "/frames.jsonl")]
+    assert frames[0]["metrics"]["loss"] == [0.5, 0.25]
+    assert frames[1]["it_end"] == 4 and frames[1]["k"] == 2
+
+
+def test_device_stream_sets_memory_watermark_gauges(monkeypatch):
+    import gymfx_tpu.telemetry.mfu as mfu_mod
+
+    monkeypatch.setattr(
+        mfu_mod, "device_memory_watermarks",
+        lambda device=None: {"bytes_in_use": 123, "peak_bytes_in_use": 456},
+    )
+    reg = MetricsRegistry()
+    s = DeviceMetricStream("ppo", iters=2, registry=reg)
+    s.after_dispatch(0, 1, {"loss": np.array([0.5])})
+    s.after_dispatch(1, 1, {"loss": np.array([0.25])})
+    s.finish()
+    gauge = reg.gauge("gymfx_device_memory_bytes", labels=("algo", "stat"))
+    assert gauge.value(algo="ppo", stat="bytes_in_use") == 123.0
+    assert gauge.value(algo="ppo", stat="peak_bytes_in_use") == 456.0
+
+
+def test_device_memory_watermarks_filters_allocator_stats():
+    from gymfx_tpu.telemetry.mfu import device_memory_watermarks
+
+    class FakeDevice:
+        def memory_stats(self):
+            return {"bytes_in_use": 10, "peak_bytes_in_use": 20,
+                    "num_allocs": 999}
+
+    out = device_memory_watermarks(FakeDevice())
+    assert out == {"bytes_in_use": 10, "peak_bytes_in_use": 20}
+
+    class NoStats:
+        def memory_stats(self):
+            return None
+
+    assert device_memory_watermarks(NoStats()) is None
+
+    class Broken:
+        def memory_stats(self):
+            raise RuntimeError("backend hides stats")
+
+    assert device_memory_watermarks(Broken()) is None
+
+
+def test_late_compiles_gauge_binds_only_when_engine_exposes_it():
+    import types
+    from collections import deque
+
+    from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+    class _Batcher:
+        def __init__(self, engine):
+            self._pending = deque()
+            self._inflight = 0
+            self.max_queue = None
+            self.breaker = None
+            self.engine = engine
+
+    # an engine WITH the counter: callback gauge reads it live
+    reg = MetricsRegistry()
+    engine = types.SimpleNamespace(late_compiles=0)
+    ServeInstruments(reg, name="warm").bind_batcher(_Batcher(engine))
+    gauge = reg.gauge("gymfx_serve_late_compiles_total", labels=("batcher",))
+    assert gauge.value(batcher="warm") == 0.0
+    engine.late_compiles = 3
+    assert gauge.value(batcher="warm") == 3.0
+    text = render(reg)
+    assert 'gymfx_serve_late_compiles_total{batcher="warm"} 3' in text
+
+    # an engine WITHOUT it (FakeEngine-style test doubles): no family
+    reg2 = MetricsRegistry()
+    ServeInstruments(reg2, name="fake").bind_batcher(
+        _Batcher(types.SimpleNamespace()))
+    assert "gymfx_serve_late_compiles_total" not in reg2.snapshot()

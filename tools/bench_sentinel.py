@@ -1,0 +1,234 @@
+#!/usr/bin/env python3
+"""Bench-regression sentinel: the CI gate over the committed bench rows.
+
+Loads every ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` wrapper, decides
+per ROW whether it is comparable (the ``comparable`` key of the shared
+``emit_bench_record`` contract wins; rows predating the key fall back
+to an honesty heuristic — aborted probes, zero values, and cpu-proxy
+platforms are not anchors), computes the per-metric trajectory, and
+fails when the LATEST comparable value regresses more than
+``--threshold`` below the best previous comparable value — or when a
+current-generation row (one carrying ``comparable``) drifts off the
+committed ``bench_contract_schema.json``.
+
+    python tools/bench_sentinel.py --check
+    python tools/bench_sentinel.py --check --dir . --threshold 0.2
+
+Exit 0 = trajectory healthy; 1 = regression or schema drift; the
+report names every skipped row and why, so "passes" can never mean
+"silently ignored the bad rows".  tests/test_bench_sentinel.py imports
+:func:`load_bench_rows` / :func:`sentinel_report` directly, keeping
+this gate inside tier-1 as well as in tools/run_tests.sh.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+_HERE = Path(__file__).resolve().parent
+if str(_HERE) not in sys.path:  # allow `python tools/bench_sentinel.py`
+    sys.path.insert(0, str(_HERE))
+if str(_HERE.parent) not in sys.path:
+    sys.path.insert(0, str(_HERE.parent))
+
+from check_bench_contract import load_schema, validate_record  # noqa: E402
+
+DEFAULT_THRESHOLD = 0.2
+_ROUND_RE = re.compile(r"r(\d+)", re.IGNORECASE)
+
+
+def _round_of(path: Path, wrapper: Dict[str, Any]) -> int:
+    n = wrapper.get("n")
+    if isinstance(n, int):
+        return n
+    m = _ROUND_RE.search(path.stem)
+    return int(m.group(1)) if m else -1
+
+
+def classify(wrapper: Dict[str, Any]) -> Dict[str, Any]:
+    """Comparability verdict for one wrapper: explicit ``comparable``
+    key wins; legacy rows (no key) get the honesty heuristic."""
+    record = wrapper.get("parsed")
+    if not isinstance(record, dict):
+        return {"comparable": False, "why": "no_record", "record": None}
+    rc = wrapper.get("rc")
+    if rc not in (0, None):
+        return {"comparable": False, "why": f"rc={rc}", "record": record}
+    if "comparable" in record:
+        why = "declared" if record["comparable"] else (
+            "declared_non_comparable"
+        )
+        return {"comparable": bool(record["comparable"]), "why": why,
+                "record": record}
+    value = record.get("value")
+    unit = str(record.get("unit", ""))
+    if "ABORTED" in unit.upper():
+        return {"comparable": False, "why": "aborted", "record": record}
+    if not isinstance(value, (int, float)) or isinstance(value, bool) \
+            or not value > 0:
+        return {"comparable": False, "why": "non_positive_value",
+                "record": record}
+    if str(record.get("platform", "")).lower() == "cpu":
+        return {"comparable": False, "why": "cpu_proxy", "record": record}
+    return {"comparable": True, "why": "legacy_heuristic", "record": record}
+
+
+def load_bench_rows(bench_dir: str = ".") -> List[Dict[str, Any]]:
+    """Every BENCH_r*/MULTICHIP_r* wrapper in round order, classified."""
+    root = Path(bench_dir)
+    rows: List[Dict[str, Any]] = []
+    for path in sorted(root.glob("BENCH_r*.json")) + sorted(
+            root.glob("MULTICHIP_r*.json")):
+        try:
+            wrapper = json.loads(path.read_text(encoding="utf-8"))
+        except Exception as exc:
+            rows.append({"file": path.name, "round": -1, "comparable": False,
+                         "why": f"unparseable: {exc}", "record": None})
+            continue
+        verdict = classify(wrapper)
+        verdict.update(file=path.name, round=_round_of(path, wrapper))
+        rows.append(verdict)
+    rows.sort(key=lambda r: (r["round"], r["file"]))
+    return rows
+
+
+def sentinel_report(
+    rows: List[Dict[str, Any]],
+    *,
+    threshold: float = DEFAULT_THRESHOLD,
+    schema: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The gate verdict: per-metric trajectory + schema-drift findings.
+
+    Regression rule: for each metric, the LATEST comparable value must
+    not sit more than ``threshold`` below the best PREVIOUS comparable
+    value.  Schema rule: any row carrying the ``comparable`` key (the
+    current emit_bench_record generation) must validate against the
+    committed contract; older rows predate the contract's growth and
+    are trajectory-only.
+    """
+    if schema is None:
+        schema = load_schema()
+    skipped = [
+        {"file": r["file"], "why": r["why"]}
+        for r in rows if not r["comparable"]
+    ]
+    drift: List[str] = []
+    for row in rows:
+        record = row.get("record")
+        if isinstance(record, dict) and "comparable" in record:
+            for problem in validate_record(record, schema):
+                drift.append(f"{row['file']}: {problem}")
+
+    metrics: Dict[str, Dict[str, Any]] = {}
+    regressions: List[str] = []
+    for row in rows:
+        if not row["comparable"]:
+            continue
+        record = row["record"]
+        metric = record.get("metric", "?")
+        value = record.get("value")
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            drift.append(f"{row['file']}: comparable row has non-numeric "
+                         f"value {value!r}")
+            continue
+        metrics.setdefault(metric, {"points": []})["points"].append(
+            {"file": row["file"], "round": row["round"],
+             "value": float(value)}
+        )
+    for metric, data in metrics.items():
+        points = data["points"]
+        latest = points[-1]
+        best_prev = max((p["value"] for p in points[:-1]), default=None)
+        data["latest"] = latest
+        data["best_previous"] = best_prev
+        if best_prev is not None and best_prev > 0:
+            ratio = latest["value"] / best_prev
+            data["vs_best_previous"] = round(ratio, 4)
+            if ratio < 1.0 - threshold:
+                regressions.append(
+                    f"{metric}: latest {latest['value']:.6g} "
+                    f"({latest['file']}) is {100 * (1 - ratio):.1f}% below "
+                    f"best previous {best_prev:.6g} "
+                    f"(threshold {100 * threshold:.0f}%)"
+                )
+    ok = not regressions and not drift
+    return {
+        "ok": ok,
+        "threshold": threshold,
+        "metrics": metrics,
+        "skipped": skipped,
+        "regressions": regressions,
+        "schema_drift": drift,
+    }
+
+
+def _publish_verdict(report: Dict[str, Any]) -> None:
+    """Ledger the gate verdict when a run ledger is active (best
+    effort — the sentinel runs standalone in CI most of the time)."""
+    try:
+        from gymfx_tpu.telemetry.ledger import get_active_ledger
+
+        ledger = get_active_ledger()
+        if ledger is not None:
+            ledger.record(
+                "gate_verdict", gate="bench_sentinel",
+                verdict="pass" if report["ok"] else "fail",
+                regressions=report["regressions"],
+                schema_drift=report["schema_drift"],
+            )
+    except Exception:
+        pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="run the gate (the only mode; explicit for CI "
+                         "legibility)")
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*/MULTICHIP_r* rows")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="max tolerated fractional regression of the "
+                         "latest comparable value vs the best previous "
+                         "(default %(default)s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON on stdout")
+    args = ap.parse_args(argv)
+
+    rows = load_bench_rows(args.dir)
+    if not rows:
+        print(f"bench sentinel: no BENCH_r*/MULTICHIP_r* rows under "
+              f"{args.dir!r}", file=sys.stderr)
+        return 1
+    report = sentinel_report(rows, threshold=args.threshold)
+    _publish_verdict(report)
+
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for skip in report["skipped"]:
+            print(f"  skip {skip['file']}: {skip['why']}")
+        for metric, data in sorted(report["metrics"].items()):
+            latest = data["latest"]
+            line = (f"  {metric}: latest {latest['value']:.6g} "
+                    f"({latest['file']})")
+            if data.get("best_previous") is not None:
+                line += (f", best previous {data['best_previous']:.6g}"
+                         f", ratio {data.get('vs_best_previous')}")
+            print(line)
+        for problem in report["schema_drift"]:
+            print(f"BENCH SENTINEL SCHEMA DRIFT: {problem}",
+                  file=sys.stderr)
+        for problem in report["regressions"]:
+            print(f"BENCH SENTINEL REGRESSION: {problem}", file=sys.stderr)
+        print("bench sentinel OK" if report["ok"] else "bench sentinel FAIL")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

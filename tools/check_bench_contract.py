@@ -83,6 +83,19 @@ def validate_record(record: Dict[str, Any],
             problems.append(
                 f"key {key!r} must be a JSON object, got {record[key]!r}"
             )
+    for key in spec.get("boolean", ()):
+        if key in record and not isinstance(record[key], bool):
+            problems.append(
+                f"key {key!r} must be a JSON boolean, got {record[key]!r}"
+            )
+    for key in spec.get("string", ()):
+        if key in record and not (
+            isinstance(record[key], str) and record[key]
+        ):
+            problems.append(
+                f"key {key!r} must be a non-empty string, "
+                f"got {record[key]!r}"
+            )
     return problems
 
 

@@ -119,10 +119,12 @@ def build_record(*, n_envs: int, horizon: int, iters: int,
     )
     report = mfu_report(analytic / n, per_step_s, device)
 
+    from gymfx_tpu.bench_util import stamp_comparability
+
     per_chip = aggregate / n
     efficiency = (aggregate / sps_single) / n
     on_tpu = device.platform == "tpu"
-    return {
+    return stamp_comparability({
         "metric": "multichip_env_steps_per_sec",
         "value": round(aggregate, 1),
         "unit": "aggregate env steps/sec across the mesh (PPO MLP bf16 "
@@ -146,7 +148,7 @@ def build_record(*, n_envs: int, horizon: int, iters: int,
         # analytic per-chip FLOP model + memory accounting
         # (gymfx_tpu/telemetry/mfu.py); null where the backend cannot say
         **report,
-    }
+    }, device=device)
 
 
 def main() -> int:

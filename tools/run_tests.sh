@@ -18,9 +18,13 @@
 #
 # After the suite: the scenario robustness gate in quick mode (three
 # scengen presets + the serving-fallback leg, schema-pinned report —
-# docs/scenarios.md), then a telemetry smoke (ephemeral /metrics
-# endpoint, one scrape, assert non-empty — docs/observability.md) and a
-# per-run summary row appended to PROGRESS.jsonl through the JSONL sink.
+# docs/scenarios.md), the bench-regression sentinel over the committed
+# BENCH_r*/MULTICHIP_r* rows (plus a synthetic-regression fixture that
+# must fail), a run-ledger smoke (tiny training run, ledger validated
+# against the committed schema), then a telemetry smoke (ephemeral
+# /metrics endpoint, one scrape, assert non-empty —
+# docs/observability.md) and a per-run summary row appended to
+# PROGRESS.jsonl through the JSONL sink.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -47,6 +51,76 @@ env JAX_PLATFORMS=cpu python bench.py --quick \
     | env JAX_PLATFORMS=cpu python tools/check_bench_contract.py \
     || bench_rc=$?
 echo "bench contract (quick, rollout_env_kernel=interpret): rc=$bench_rc"
+
+# bench-regression sentinel: the committed BENCH_r*/MULTICHIP_r* rows
+# must keep a healthy trajectory (explicitly non-comparable rows are
+# skipped BY KEY), and the gate must still FAIL when handed a synthetic
+# 25% regression — a sentinel that cannot fail is not a gate
+sentinel_rc=0
+python tools/bench_sentinel.py --check || sentinel_rc=$?
+echo "bench sentinel (committed rows): rc=$sentinel_rc"
+if [ "$sentinel_rc" -eq 0 ]; then
+    python - <<'EOF' || sentinel_rc=$?
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+with tempfile.TemporaryDirectory() as d:
+    for n, value in ((1, 100.0), (2, 75.0)):  # 25% drop: must fail
+        (Path(d) / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n, "rc": 0, "cmd": "synthetic-regression-fixture",
+            "parsed": {"metric": "ppo_env_steps_per_sec_per_chip",
+                       "value": value, "unit": "env steps/sec"},
+        }))
+    rc = subprocess.run(
+        [sys.executable, "tools/bench_sentinel.py", "--check", "--dir", d],
+        capture_output=True,
+    ).returncode
+if rc != 1:
+    print(f"bench sentinel did NOT flag a synthetic regression (rc={rc})")
+    sys.exit(1)
+print("bench sentinel correctly fails the synthetic-regression fixture")
+EOF
+fi
+
+# run-ledger smoke: a two-iteration CPU training run with the ledger
+# (+ flight recorder + compile watch) on must produce a ledger that
+# validates against the committed schema end-to-end
+ledger_rc=0
+env JAX_PLATFORMS=cpu python - <<'EOF' || ledger_rc=$?
+import sys
+import tempfile
+from pathlib import Path
+
+from gymfx_tpu.config.defaults import DEFAULT_VALUES
+from gymfx_tpu.telemetry.ledger import read_ledger, validate_ledger
+from gymfx_tpu.train.ppo import train_from_config
+
+with tempfile.TemporaryDirectory() as d:
+    ledger = str(Path(d) / "ledger.jsonl")
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update({
+        "input_file": "tests/data/eurusd_uptrend.csv",
+        "window_size": 8, "num_envs": 4, "ppo_horizon": 16,
+        "ppo_epochs": 1, "ppo_minibatches": 1,
+        "policy_kwargs": {"hidden": [16, 16]},
+        "train_total_steps": 128, "seed": 1,
+        "telemetry_ledger": ledger,
+        "telemetry_compile_watch": True,
+    })
+    train_from_config(cfg)
+    problems = validate_ledger(ledger)
+    if problems:
+        print("LEDGER SCHEMA VIOLATIONS:", *problems, sep="\n  ")
+        sys.exit(1)
+    kinds = [r["kind"] for r in read_ledger(ledger)]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end", kinds
+    assert "superstep_dispatch" in kinds and "compile_end" in kinds, kinds
+    print(f"run-ledger smoke OK ({len(kinds)} rows, schema-valid)")
+EOF
+echo "run-ledger smoke: rc=$ledger_rc"
 
 # telemetry smoke + PROGRESS row (registry/http/sink are jax-free:
 # this is sub-second and runs even when the suite failed, so the row
@@ -95,5 +169,11 @@ if [ "$gate_rc" -ne 0 ]; then
 fi
 if [ "$bench_rc" -ne 0 ]; then
     exit "$bench_rc"
+fi
+if [ "$sentinel_rc" -ne 0 ]; then
+    exit "$sentinel_rc"
+fi
+if [ "$ledger_rc" -ne 0 ]; then
+    exit "$ledger_rc"
 fi
 exit "$smoke_rc"
