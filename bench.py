@@ -220,8 +220,9 @@ def main() -> None:
     )
     ap.add_argument(
         "--trace", type=str, default=None, metavar="DIR",
-        help="capture a jax.profiler trace of the timed loop into DIR "
-             "(view with tensorboard or xprof)",
+        help="capture a managed jax.profiler trace of one fused step "
+             "into a manifested capture bundle under DIR (read back "
+             "with tools/profile_report.py, or view with tensorboard)",
     )
     # LOB matching-engine sweep (docs/lob.md)
     ap.add_argument(
@@ -339,11 +340,42 @@ def main() -> None:
             update_gemm_frac = min(1.0, update_flops / step_flops)
 
     if args.trace:
-        # one traced fused step on the already-compiled executable
-        jax.profiler.start_trace(args.trace)
-        state, _m = _step(state)
-        jax.block_until_ready(state)
-        jax.profiler.stop_trace()
+        # one traced fused step through the managed capture path: the
+        # bundle manifest reuses the already-compiled executable (HLO
+        # scope map + cost-model FLOPs) and the phase split measured
+        # above — zero extra compiles vs the raw start/stop_trace
+        from gymfx_tpu.telemetry.ledger import config_digest
+        from gymfx_tpu.telemetry.profiler import ProfilerSession
+
+        session = ProfilerSession(
+            args.trace, config_sha256=config_digest(dict(config))
+        )
+
+        def _trace_workload(it_start, k):
+            info = {
+                "algo": "ppo", "n_envs": args.n_envs,
+                "horizon": args.horizon,
+                "steps_per_iter": args.n_envs * args.horizon,
+                "xla_flops_per_dispatch": step_flops,
+                "xla_flops_per_step": step_flops,
+                "phase_split": (
+                    {"rollout_ms": rollout_ms, "update_ms": update_ms,
+                     "iters": args.iters, "source": "measure_phase_split"}
+                    if rollout_ms is not None else None
+                ),
+            }
+            try:
+                info["hlo_text"] = _step.as_text()
+            except Exception:
+                pass
+            return info
+
+        session.set_workload_source(_trace_workload)
+        with session.capture(label="bench_trace") as cap:
+            state, _m = _step(state)
+            jax.block_until_ready(state)
+        if cap.bundle:
+            print(f"# trace capture bundle: {cap.bundle}")
 
     K = max(1, args.supersteps)
     baseline_per_chip = 1_000_000 / 8  # BASELINE.json: 1M steps/s on v5p-8

@@ -155,4 +155,10 @@ def parse_args(argv=None):
         "--telemetry_compile_watch", action="store_true", default=None
     )
 
+    # performance observatory (docs/observability.md: managed
+    # jax.profiler capture + measured-MFU reports); off unless set
+    parser.add_argument("--telemetry_profile_dir", type=str)
+    parser.add_argument("--telemetry_profile_supersteps", type=str)
+    parser.add_argument("--telemetry_profile_every", type=int)
+
     return parser.parse_known_args(argv)

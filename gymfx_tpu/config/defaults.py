@@ -258,4 +258,16 @@ DEFAULT_VALUES = {
     # fingerprinting (gymfx_compile_* metrics, silent-recompile and
     # serve-bucket-miss detection)
     "telemetry_compile_watch": False,
+
+    # ---- performance observatory (telemetry/profiler.py) ----
+    # capture-bundle directory for managed jax.profiler traces around
+    # superstep windows (manifest + scope map + profile_capture ledger
+    # event; read back by tools/profile_report.py); null = no profiling
+    "telemetry_profile_dir": None,
+    # comma-separated superstep indices to capture ("1" or "1,8");
+    # null with profile_dir set = capture superstep 1 (the first
+    # dispatch whose window holds no jit compile)
+    "telemetry_profile_supersteps": None,
+    # additionally capture every Nth superstep; 0 = off
+    "telemetry_profile_every": 0,
 }

@@ -54,6 +54,7 @@ class ResilientLoop:
         loggers: Tuple[Any, ...] = (),
         ledger: Any = None,
         recorder: Any = None,
+        profiler: Any = None,
     ):
         self.steps_per_iter = int(steps_per_iter)
         self.checkpoint_dir = str(checkpoint_dir) if checkpoint_dir else None
@@ -76,6 +77,10 @@ class ResilientLoop:
         # recorder dumps its postmortem bundle on the abort paths
         self.ledger = ledger
         self.recorder = recorder
+        # managed jax.profiler capture (telemetry/profiler.py): the loop
+        # owns the cadence — begin_superstep opens the trace window,
+        # after_superstep closes it and writes the capture bundle
+        self.profiler = profiler
         self.last_checkpoint_step: Optional[int] = None
         # (it_start, k, guard metrics) — scalars for k == 1, stacked
         # (k,) arrays for a fused superstep
@@ -143,6 +148,17 @@ class ResilientLoop:
             raise
 
     # ------------------------------------------------------------------
+    def begin_superstep(self, it_start: int, k: int = 1) -> bool:
+        """Open a profiler capture window when the configured cadence
+        says this dispatch is due; returns whether a capture is now
+        active — the caller must block the dispatch result before
+        :meth:`after_superstep` so the trace covers the device work.
+        A no-op (False) without a profiler, so the fast path is one
+        attribute check."""
+        if self.profiler is None:
+            return False
+        return self.profiler.start_capture(it_start, k)
+
     def after_superstep(self, it_start: int, k: int, metrics: Dict[str, Any],
                         state_fn: StateFn) -> None:
         """Superstep-aware hook: call once after dispatching iterations
@@ -161,6 +177,10 @@ class ResilientLoop:
         if self.ledger is not None:
             self.ledger.record("superstep_dispatch",
                                it_start=int(it_start), k=int(k))
+        if self.profiler is not None and self.profiler.capturing:
+            # close the window begin_superstep opened (never raises);
+            # runs before the watchdog so an abort still gets its bundle
+            self.profiler.finish_capture()
         if self.monitor is not None:
             self._check_pending(state_fn)
             self._pending = (

@@ -24,6 +24,15 @@ Run-forensics knobs (same off-by-default contract):
   ``telemetry_flight_recorder_k``   frames the ring buffer retains
   ``telemetry_compile_watch``       jax.monitoring compile listeners +
                                     executable fingerprinting
+
+Performance-observatory knobs (same off-by-default contract):
+
+  ``telemetry_profile_dir``        managed jax.profiler capture-bundle
+                                   directory (telemetry/profiler.py)
+  ``telemetry_profile_supersteps`` superstep indices to capture
+                                   (comma-separated; default "1")
+  ``telemetry_profile_every``      additionally capture every Nth
+                                   superstep (0 = off)
 """
 from __future__ import annotations
 
@@ -54,6 +63,15 @@ from gymfx_tpu.telemetry.ledger import (  # noqa: F401
     set_active_ledger,
     validate_ledger,
 )
+from gymfx_tpu.telemetry.attribution import (  # noqa: F401
+    build_profile_report,
+    compare_profile_reports,
+    validate_profile_report,
+)
+from gymfx_tpu.telemetry.profiler import (  # noqa: F401
+    ProfilerSession,
+    find_captures,
+)
 from gymfx_tpu.telemetry.sink import JsonlSink, append_jsonl  # noqa: F401
 from gymfx_tpu.telemetry.slo import SLOWindow  # noqa: F401
 from gymfx_tpu.telemetry.spans import Tracer, null_tracer  # noqa: F401
@@ -68,12 +86,16 @@ __all__ = [
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
+    "ProfilerSession",
     "RunLedger",
     "SLOWindow",
     "Telemetry",
     "Tracer",
     "append_jsonl",
+    "build_profile_report",
+    "compare_profile_reports",
     "config_digest",
+    "find_captures",
     "get_active_ledger",
     "global_registry",
     "null_tracer",
@@ -83,6 +105,7 @@ __all__ = [
     "telemetry_from_config",
     "validate_ledger",
     "validate_postmortem",
+    "validate_profile_report",
 ]
 
 
@@ -100,6 +123,7 @@ class Telemetry:
         ledger: Optional[RunLedger] = None,
         recorder: Optional[FlightRecorder] = None,
         compile_watch: Optional[CompileWatch] = None,
+        profiler: Optional[ProfilerSession] = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.sink = sink
@@ -109,6 +133,7 @@ class Telemetry:
         self.ledger = ledger
         self.recorder = recorder
         self.compile_watch = compile_watch
+        self.profiler = profiler
         self._server = None
 
     # -- construction helpers the layers share -------------------------
@@ -152,6 +177,8 @@ class Telemetry:
         if self._server is not None:
             self._server.close()
             self._server = None
+        if self.profiler is not None:
+            self.profiler.close()  # finalize a capture an abort left open
         if self.compile_watch is not None:
             self.compile_watch.uninstall()
         if self.ledger is not None:
@@ -173,8 +200,9 @@ def telemetry_from_config(config: Dict[str, Any]) -> Optional[Telemetry]:
     ledger_path = config.get("telemetry_ledger") or None
     recorder_dir = config.get("telemetry_flight_recorder_dir") or None
     watch = bool(config.get("telemetry_compile_watch"))
+    profile_dir = config.get("telemetry_profile_dir") or None
     if not (enabled or jsonl or spans or port is not None
-            or ledger_path or recorder_dir or watch):
+            or ledger_path or recorder_dir or watch or profile_dir):
         return None
     registry = MetricsRegistry()
     sink = JsonlSink(str(jsonl)) if jsonl else None
@@ -201,6 +229,17 @@ def telemetry_from_config(config: Dict[str, Any]) -> Optional[Telemetry]:
         compile_watch = CompileWatch(
             registry, ledger=ledger, recorder=recorder
         ).install()
+    profiler = None
+    if profile_dir:
+        profiler = ProfilerSession(
+            str(profile_dir),
+            supersteps=config.get("telemetry_profile_supersteps"),
+            every=int(config.get("telemetry_profile_every", 0) or 0),
+            config_sha256=sha,
+            registry=registry,
+            ledger=ledger,
+            compile_watch=compile_watch,
+        )
     return Telemetry(
         registry=registry,
         sink=sink,
@@ -210,4 +249,5 @@ def telemetry_from_config(config: Dict[str, Any]) -> Optional[Telemetry]:
         ledger=ledger,
         recorder=recorder,
         compile_watch=compile_watch,
+        profiler=profiler,
     )

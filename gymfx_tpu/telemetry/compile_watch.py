@@ -210,6 +210,17 @@ class CompileWatch:
         with self._lock:
             return len(self._fingerprints)
 
+    def fingerprints(self) -> Dict[str, Optional[str]]:
+        """Snapshot of every executable identity seen so far:
+        ``{"name|key": lowered-HLO sha256-or-None}`` — what the
+        profiler stamps into capture manifests so a trace is
+        attributable to exact program versions."""
+        with self._lock:
+            return {
+                f"{name}|{key}": sha
+                for (name, key), sha in self._fingerprints.items()
+            }
+
     # -- serving-engine binding ----------------------------------------
     def watch_engine(self, engine: Any, *, name: str = "serve") -> None:
         """Attach to an :class:`~gymfx_tpu.serve.engine.InferenceEngine`:

@@ -46,6 +46,7 @@ EVENT_KINDS = (
     "bench_row",
     "serve_bucket_miss",
     "postmortem_dump",
+    "profile_capture",
 )
 
 

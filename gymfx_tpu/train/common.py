@@ -420,3 +420,75 @@ def shard_train_state(
     return ShardedRuntime(mesh).place_groups(
         params=params, replicated=replicated, batched=batched
     )
+
+
+def profiler_workload(
+    trainer: Any,
+    state: Any,
+    k: int,
+    *,
+    algo: str,
+    params: Any,
+    n_envs: int,
+    horizon: int,
+    update_epochs: int = 1,
+    split_iters: int = 2,
+) -> Dict[str, Any]:
+    """Capture-time workload payload for a profiler bundle manifest
+    (:meth:`~gymfx_tpu.telemetry.profiler.ProfilerSession.set_workload_source`):
+    the dispatched program's optimized HLO (-> the rollout/update scope
+    map), its XLA cost-model FLOPs, the analytic FLOP model, and the
+    ``measure_phase_split`` baseline the report reconciles against.
+
+    Runs OUTSIDE the capture window (after stop_trace) and pays one AOT
+    recompile of the dispatched program plus the two phase sub-programs
+    — only on capture supersteps.  ``measure_phase_split`` donates its
+    input, so it runs on a copy of the live ``state``; never raises
+    (the profiler counts a workload_error instead).
+    """
+    from gymfx_tpu.bench_util import compile_with_flops, measure_phase_split
+
+    info: Dict[str, Any] = {
+        "algo": str(algo),
+        "n_envs": int(n_envs),
+        "horizon": int(horizon),
+        "steps_per_iter": int(n_envs) * int(horizon),
+    }
+    k = max(1, int(k))
+    if k == 1:
+        compiled, flops = compile_with_flops(trainer._train_step, state)
+    else:
+        compiled, flops = compile_with_flops(trainer._train_many, state, k)
+    if compiled is not None:
+        try:
+            info["hlo_text"] = compiled.as_text()
+        except Exception:
+            pass
+    info["xla_flops_per_dispatch"] = flops
+    info["xla_flops_per_step"] = (flops / k) if flops else None
+    try:
+        from gymfx_tpu.telemetry.mfu import analytic_train_step_flops
+
+        info["analytic_flops_per_step"] = analytic_train_step_flops(
+            params, num_envs=int(n_envs), horizon=int(horizon),
+            update_epochs=int(update_epochs),
+        )
+    except Exception:
+        info["analytic_flops_per_step"] = None
+    try:
+        split = measure_phase_split(
+            trainer, jax.tree.map(jnp.copy, state), int(split_iters)
+        )
+    except Exception:
+        split = None
+    if split is not None:
+        rollout_s, update_s, _split_state, _u_flops = split
+        info["phase_split"] = {
+            "rollout_ms": rollout_s / int(split_iters) * 1e3,
+            "update_ms": update_s / int(split_iters) * 1e3,
+            "iters": int(split_iters),
+            "source": "measure_phase_split",
+        }
+    else:
+        info["phase_split"] = None
+    return info

@@ -522,7 +522,20 @@ class ImpalaTrainer:
             loggers=(logger,),
             ledger=telemetry.ledger if telemetry is not None else None,
             recorder=telemetry.recorder if telemetry is not None else None,
+            profiler=telemetry.profiler if telemetry is not None else None,
         )
+        if telemetry is not None and telemetry.profiler is not None:
+            from gymfx_tpu.train.common import profiler_workload
+
+            # late-binding over the rebound local (see PPO): resolved
+            # at bundle-write time against the live state
+            telemetry.profiler.set_workload_source(
+                lambda it_start, kk: profiler_workload(
+                    self, state, kk, algo="impala",
+                    params=state.learner_params,
+                    n_envs=self.icfg.n_envs, horizon=self.icfg.unroll,
+                )
+            )
         if telemetry is not None and telemetry.recorder is not None:
             # the closure reads the rebound local, so a postmortem dump
             # captures the rng key the run DIED with, not the seed key
@@ -541,6 +554,7 @@ class ImpalaTrainer:
         it = 0
         while it < iters:
             k = min(K, iters - it)
+            capturing = hooks.begin_superstep(it, k)
             with tracer.span("train/superstep", algo="impala", it=it, k=k):
                 if k == 1:
                     state, metrics = self.train_step(state)
@@ -549,6 +563,10 @@ class ImpalaTrainer:
                     state, stacked = self.train_many(state, k)
                     metrics = jax.tree.map(lambda x: x[-1], stacked)
                     guard_metrics = stacked
+            if capturing:
+                # sync so the trace window covers the device work —
+                # only on capture supersteps (see PPO)
+                jax.block_until_ready(state)
             # logger first: an aborting hook flushes the attached logger,
             # which must already hold this superstep's metrics (see PPO)
             logger.after_dispatch(it, k, guard_metrics)

@@ -667,7 +667,21 @@ class PPOTrainer:
             loggers=(logger,),
             ledger=telemetry.ledger if telemetry is not None else None,
             recorder=telemetry.recorder if telemetry is not None else None,
+            profiler=telemetry.profiler if telemetry is not None else None,
         )
+        if telemetry is not None and telemetry.profiler is not None:
+            from gymfx_tpu.train.common import profiler_workload
+
+            # late-binding over the rebound local: the manifest payload
+            # (HLO scope map, FLOPs, phase split on a state copy) is
+            # resolved at bundle-write time against the live state
+            telemetry.profiler.set_workload_source(
+                lambda it_start, kk: profiler_workload(
+                    self, state, kk, algo="ppo", params=state.params,
+                    n_envs=self.pcfg.n_envs, horizon=self.pcfg.horizon,
+                    update_epochs=self.pcfg.epochs,
+                )
+            )
         if telemetry is not None and telemetry.recorder is not None:
             # the closure reads the rebound local, so a postmortem dump
             # captures the rng key the run DIED with, not the seed key
@@ -686,6 +700,7 @@ class PPOTrainer:
         it = 0
         while it < iters:
             k = min(K, iters - it)
+            capturing = hooks.begin_superstep(it, k)
             with tracer.span("train/superstep", algo="ppo", it=it, k=k):
                 if k == 1:
                     state, metrics = self.train_step(state)
@@ -695,6 +710,10 @@ class PPOTrainer:
                     # newest iteration's metrics, still on device (no sync)
                     metrics = jax.tree.map(lambda x: x[-1], stacked)
                     guard_metrics = stacked
+            if capturing:
+                # the trace window must cover the device work, so the
+                # async dispatch is synced — only on capture supersteps
+                jax.block_until_ready(state)
             # logger BEFORE hooks: when the hooks abort (preemption,
             # divergence) they flush the attached logger, so the final
             # superstep's held metrics must already be in its hands

@@ -198,6 +198,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(default %(default)s)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full report as JSON on stdout")
+    ap.add_argument("--profile-compare", nargs=2, default=None,
+                    metavar=("BASE_REPORT", "NEW_REPORT"),
+                    help="also gate per-kernel regressions between two "
+                         "profile_report.json files (telemetry/"
+                         "attribution.py compare, threshold reuses "
+                         "--threshold scaled by --profile-threshold)")
+    ap.add_argument("--profile-threshold", type=float, default=0.25,
+                    help="per-kernel regression threshold for "
+                         "--profile-compare (default %(default)s)")
     args = ap.parse_args(argv)
 
     rows = load_bench_rows(args.dir)
@@ -206,6 +215,36 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{args.dir!r}", file=sys.stderr)
         return 1
     report = sentinel_report(rows, threshold=args.threshold)
+
+    if args.profile_compare:
+        # kernel-level gate riding the same sentinel verdict: an
+        # end-to-end steps/sec pass cannot mask a fused kernel that
+        # quietly fell off a fusion cliff
+        from gymfx_tpu.telemetry.attribution import compare_profile_reports
+
+        base_path, new_path = args.profile_compare
+        try:
+            base = json.loads(Path(base_path).read_text(encoding="utf-8"))
+            new = json.loads(Path(new_path).read_text(encoding="utf-8"))
+            prof = compare_profile_reports(
+                base, new, threshold=args.profile_threshold
+            )
+        except Exception as exc:
+            prof = {"ok": False, "regressions": [],
+                    "error": f"profile compare failed: {exc!r}"}
+        report["profile_compare"] = prof
+        if not prof["ok"]:
+            report["ok"] = False
+            for reg in prof.get("regressions", []):
+                report["regressions"].append(
+                    f"profile kernel regression: {reg.get('name')} "
+                    f"{reg.get('base_ms_per_step')} -> "
+                    f"{reg.get('new_ms_per_step')} ms/step "
+                    f"(ratio {reg.get('ratio')})"
+                )
+            if prof.get("error"):
+                report["regressions"].append(prof["error"])
+
     _publish_verdict(report)
 
     if args.json:

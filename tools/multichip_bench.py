@@ -68,12 +68,16 @@ def _trainer(n_envs: int, horizon: int, mesh=None):
 
 
 def build_record(*, n_envs: int, horizon: int, iters: int,
-                 mesh_shape=None, measure_split: bool = True) -> dict:
+                 mesh_shape=None, measure_split: bool = True,
+                 profile_dir=None) -> dict:
     """Measure single-device vs mesh-sharded throughput; returns the
     contract record (metric ``multichip_env_steps_per_sec``).
     ``measure_split=False`` skips the phase-split sub-programs (two
     extra AOT compiles) and reports null rollout/update — the CI quick
-    path, where compile time dominates the whole measurement."""
+    path, where compile time dominates the whole measurement.  With
+    ``profile_dir``, one sharded dispatch is trace-captured through the
+    managed profiler path (manifested bundle off the same compiled
+    executable — tools/profile_report.py reads it back)."""
     import jax
 
     from gymfx_tpu.bench_util import (
@@ -96,10 +100,13 @@ def build_record(*, n_envs: int, horizon: int, iters: int,
     sps_single = n_envs * horizon * iters / dt_s
     del single, s_state
 
-    # mesh-sharded run through the shared runtime plan
+    # mesh-sharded run through the shared runtime plan (the compiled
+    # executable is kept for the optional profiler capture below)
     sharded, _ = _trainer(n_envs, horizon, mesh=mesh)
     m_state = sharded.init_state(0)
-    dt_m, _flops_m, m_state, _ = measure_train_step(sharded, m_state, iters)
+    dt_m, flops_m, m_state, m_step = measure_train_step(
+        sharded, m_state, iters
+    )
     aggregate = n_envs * horizon * iters / dt_m
     per_step_s = dt_m / iters
 
@@ -118,6 +125,40 @@ def build_record(*, n_envs: int, horizon: int, iters: int,
         update_epochs=int(config["ppo_epochs"]),
     )
     report = mfu_report(analytic / n, per_step_s, device)
+
+    if profile_dir is not None:
+        # one trace-captured sharded dispatch off the same executable
+        from gymfx_tpu.telemetry.ledger import config_digest
+        from gymfx_tpu.telemetry.profiler import ProfilerSession
+
+        session = ProfilerSession(
+            str(profile_dir), config_sha256=config_digest(dict(config))
+        )
+
+        def _profile_workload(it_start, k):
+            info = {
+                "algo": "ppo_multichip", "n_envs": n_envs,
+                "horizon": horizon, "steps_per_iter": n_envs * horizon,
+                "n_devices": n, "mesh_shape": runtime.mesh_shape,
+                "xla_flops_per_dispatch": flops_m,
+                "xla_flops_per_step": flops_m,
+                "analytic_flops_per_step": analytic,
+                "phase_split": (
+                    {"rollout_ms": rollout_ms, "update_ms": update_ms,
+                     "iters": iters, "source": "measure_phase_split"}
+                    if rollout_ms is not None else None
+                ),
+            }
+            try:
+                info["hlo_text"] = m_step.as_text()
+            except Exception:
+                pass
+            return info
+
+        session.set_workload_source(_profile_workload)
+        with session.capture(label="multichip_bench"):
+            m_state, _ = m_step(m_state)
+            jax.block_until_ready(m_state)
 
     from gymfx_tpu.bench_util import stamp_comparability
 
@@ -162,6 +203,11 @@ def main() -> int:
         help='JSON mesh shape, e.g. \'{"data": 4, "model": 2}\'; '
              "default: all local devices on the 'data' axis",
     )
+    ap.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="capture one sharded dispatch into a manifested profiler "
+             "bundle under DIR (tools/profile_report.py reads it back)",
+    )
     args = ap.parse_args()
     if args.quick:
         args.n_envs, args.horizon = 256, 16
@@ -183,6 +229,7 @@ def main() -> int:
     record = build_record(
         n_envs=args.n_envs, horizon=args.horizon, iters=args.iters,
         mesh_shape=mesh_shape, measure_split=not args.quick,
+        profile_dir=args.profile,
     )
     print(json.dumps(record))
     return 0

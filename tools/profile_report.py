@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Turn a profiler capture bundle into the schema-pinned
+``profile_report.json`` plus a human-readable markdown table — and diff
+two reports at a per-kernel regression threshold.
+
+Report mode (default):
+
+    python tools/profile_report.py RUNS/profile            # newest bundle
+    python tools/profile_report.py RUNS/profile/capture_001_it1 \
+        --out /tmp/report.json --top 20
+
+Writes ``profile_report.json`` into the bundle (or ``--out``), prints
+the markdown summary (phases, reconciliation verdict, measured-MFU
+block, top-N kernel table) to stdout, and exits non-zero when the
+report fails ``validate_profile_report``.
+
+Compare mode (what ``tools/bench_sentinel.py --profile-compare``
+drives):
+
+    python tools/profile_report.py --compare base_report.json \
+        new_report.json --threshold 0.25 --min-ms 0.05
+
+Exits 1 when any kernel's per-step time (or the end-to-end device time
+per step) regressed past the threshold; prints the verdict JSON either
+way.  Stdlib-only on the compare path, so it runs anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:  # allow `python tools/profile_report.py`
+    sys.path.insert(0, str(_REPO))
+
+
+def _fmt(value, digits=3, suffix=""):
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.{digits}f}{suffix}"
+    return f"{value}{suffix}"
+
+
+def render_markdown(report: dict) -> str:
+    manifest = report.get("manifest") or {}
+    trace = report.get("trace") or {}
+    phases = report.get("phases") or {}
+    rec = report.get("reconciliation") or {}
+    meas = report.get("mfu_measured") or {}
+    lines = [
+        f"## Profile report — {report.get('capture_dir')}",
+        "",
+        f"- platform/device: `{manifest.get('platform')}` / "
+        f"`{manifest.get('device_kind')}` "
+        f"(comparable={manifest.get('comparable')})",
+        f"- supersteps: [{manifest.get('it_start')}, "
+        f"{manifest.get('it_end')}) (k={manifest.get('k')})",
+        f"- trace: ok={trace.get('ok')} events={trace.get('events')} "
+        f"device_busy={_fmt(trace.get('device_busy_ms'))}ms "
+        f"window={_fmt(trace.get('window_ms'))}ms "
+        f"dispatch_gap={_fmt(trace.get('dispatch_gap_ms'))}ms "
+        f"({_fmt(trace.get('dispatch_gap_frac'), 3)} of window)",
+        f"- fusion coverage: {_fmt(trace.get('fusion_coverage'), 3)}",
+        "",
+        "| phase | trace ms | trace frac | split frac |",
+        "|---|---|---|---|",
+        f"| rollout | {_fmt(phases.get('rollout_ms'))} | "
+        f"{_fmt(phases.get('rollout_frac'), 3)} | "
+        f"{_fmt(rec.get('split_rollout_frac'), 3)} |",
+        f"| update | {_fmt(phases.get('update_ms'))} | "
+        f"{_fmt(phases.get('update_frac'), 3)} | "
+        f"{_fmt(1.0 - rec['split_rollout_frac'], 3) if isinstance(rec.get('split_rollout_frac'), float) else '-'} |",
+        f"| unattributed | {_fmt(phases.get('unattributed_ms'))} | - | - |",
+        "",
+        f"- reconciliation: |Δrollout_frac|="
+        f"{_fmt(rec.get('rollout_frac_abs_err'), 4)} "
+        f"(tolerance {_fmt(rec.get('tolerance'), 2)}) -> "
+        f"within_tolerance={rec.get('within_tolerance')}",
+        f"- mfu_measured: device={_fmt(meas.get('device_ms_per_step'))}"
+        f"ms/step, flops/step={_fmt(meas.get('flops_per_step'), 0)} "
+        f"({meas.get('flops_source')}), achieved="
+        f"{_fmt(meas.get('achieved_flops_per_sec'), 0)} FLOP/s, "
+        f"mfu={_fmt(meas.get('mfu'), 5)}",
+        "",
+        "| kernel | scope | count | ms/step | frac |",
+        "|---|---|---|---|---|",
+    ]
+    for row in trace.get("top_kernels") or []:
+        lines.append(
+            f"| `{row.get('name')}` | {row.get('scope') or '-'} | "
+            f"{row.get('count')} | {_fmt(row.get('total_ms_per_step'))} | "
+            f"{_fmt(row.get('frac'), 3)} |"
+        )
+    return "\n".join(lines)
+
+
+def run_compare(args: argparse.Namespace) -> int:
+    from gymfx_tpu.telemetry.attribution import compare_profile_reports
+
+    base = json.loads(Path(args.compare).read_text(encoding="utf-8"))
+    new = json.loads(Path(args.capture).read_text(encoding="utf-8"))
+    verdict = compare_profile_reports(
+        base, new, threshold=args.threshold, min_ms=args.min_ms
+    )
+    print(json.dumps(verdict, indent=2, sort_keys=True))
+    return 0 if verdict["ok"] else 1
+
+
+def run_report(args: argparse.Namespace) -> int:
+    from gymfx_tpu.telemetry.attribution import (
+        build_profile_report,
+        validate_profile_report,
+    )
+    from gymfx_tpu.telemetry.profiler import find_captures
+
+    captures = find_captures(args.capture)
+    if not captures:
+        print(f"no capture bundle (manifest.json) under {args.capture!r}",
+              file=sys.stderr)
+        return 2
+    bundle = captures[-1]  # newest: bundles are sequence-numbered
+    report = build_profile_report(
+        bundle, top_n=args.top, tolerance=args.tolerance
+    )
+    out = Path(args.out) if args.out else Path(bundle) / "profile_report.json"
+    out.write_text(
+        json.dumps(report, indent=2, sort_keys=True) + "\n", encoding="utf-8"
+    )
+    print(render_markdown(report))
+    print(f"\nreport: {out}")
+    problems = validate_profile_report(report)
+    if problems:
+        print("SCHEMA VIOLATIONS:", file=sys.stderr)
+        for problem in problems:
+            print(f"  - {problem}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("capture", help="capture bundle dir (or its ancestor); "
+                    "in --compare mode: the NEW report JSON")
+    ap.add_argument("--out", default=None,
+                    help="report path (default: <bundle>/profile_report.json)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="kernel table size (default 15)")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="phase reconciliation tolerance (default 0.25)")
+    ap.add_argument("--compare", default=None, metavar="BASE_REPORT",
+                    help="diff BASE_REPORT against the positional report "
+                    "JSON; exit 1 on per-kernel regression")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="--compare: per-kernel regression threshold "
+                    "(default 0.25 = +25%%)")
+    ap.add_argument("--min-ms", type=float, default=0.05,
+                    help="--compare: ignore kernels under this many "
+                    "ms/step in the base (default 0.05)")
+    args = ap.parse_args(argv)
+    if args.compare:
+        return run_compare(args)
+    return run_report(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

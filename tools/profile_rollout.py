@@ -1,8 +1,12 @@
 #!/usr/bin/env python3
-"""Capture a jax.profiler trace of the PPO rollout + update
-(SURVEY.md §5.1: the reference's only profiling is perf_counter
-sampling in its engine benchmark; this emits a full XLA trace viewable
-in TensorBoard / Perfetto).
+"""Capture a managed jax.profiler trace of the PPO rollout + update.
+
+Thin delegate to the performance observatory
+(gymfx_tpu/telemetry/profiler.py): the capture lands as a manifested
+bundle — trace + config sha + HLO scope map + phase-split baseline —
+that ``tools/profile_report.py`` turns into the schema-pinned
+``profile_report.json`` (measured MFU, per-kernel table, rollout vs
+update attribution).  Still viewable raw in TensorBoard / Perfetto.
 
 Usage: python tools/profile_rollout.py [outdir] [n_envs] [horizon]
 """
@@ -18,6 +22,9 @@ def main() -> int:
 
     from gymfx_tpu.config import DEFAULT_VALUES
     from gymfx_tpu.core.runtime import Environment
+    from gymfx_tpu.telemetry.ledger import config_digest
+    from gymfx_tpu.telemetry.profiler import ProfilerSession
+    from gymfx_tpu.train.common import profiler_workload
     from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
 
     outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/gymfx_trace"
@@ -35,11 +42,24 @@ def main() -> int:
     state, _ = trainer.train_step(state)  # compile outside the trace
     jax.block_until_ready(state.params)
 
-    with jax.profiler.trace(outdir):
+    session = ProfilerSession(outdir, config_sha256=config_digest(config))
+    session.set_workload_source(
+        # late-binding over the rebound local: the manifest payload is
+        # resolved after the trace stops, against the traced state
+        lambda it_start, k: profiler_workload(
+            trainer, state, 1, algo="ppo", params=state.params,
+            n_envs=n_envs, horizon=horizon,
+        )
+    )
+    with session.capture(k=3, label="profile_rollout") as cap:
         for _ in range(3):
             state, metrics = trainer.train_step(state)
         jax.block_until_ready(state.params)
-    print(f"trace written to {outdir} (open with TensorBoard or Perfetto)")
+    if cap.bundle is None:
+        print("capture failed (see capture_errors)", file=sys.stderr)
+        return 1
+    print(f"capture bundle: {cap.bundle}")
+    print("render it:  python tools/profile_report.py " + str(cap.bundle))
     return 0
 
 

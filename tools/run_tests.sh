@@ -21,8 +21,12 @@
 # docs/scenarios.md), the bench-regression sentinel over the committed
 # BENCH_r*/MULTICHIP_r* rows (plus a synthetic-regression fixture that
 # must fail), a run-ledger smoke (tiny training run, ledger validated
-# against the committed schema), then a telemetry smoke (ephemeral
-# /metrics endpoint, one scrape, assert non-empty —
+# against the committed schema), a performance-observatory smoke (a
+# profiler-armed training run must land a capture bundle whose report
+# validates against profile_report_schema.json, reconciles trace
+# attribution with the measured phase split, and whose --compare gate
+# fails a synthetic kernel regression), then a telemetry smoke
+# (ephemeral /metrics endpoint, one scrape, assert non-empty —
 # docs/observability.md) and a per-run summary row appended to
 # PROGRESS.jsonl through the JSONL sink.
 set -uo pipefail
@@ -122,6 +126,87 @@ with tempfile.TemporaryDirectory() as d:
 EOF
 echo "run-ledger smoke: rc=$ledger_rc"
 
+# performance-observatory smoke: a two-superstep CPU training run with
+# the profiler armed must land a manifested capture bundle; the report
+# CLI must render it schema-valid with the trace-measured rollout
+# fraction reconciling against measure_phase_split and mfu_measured
+# populated; and the per-kernel --compare gate must FAIL a synthetic
+# kernel regression — a compare that cannot fail is not a gate
+profile_rc=0
+env JAX_PLATFORMS=cpu python - <<'EOF' || profile_rc=$?
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+from gymfx_tpu.config.defaults import DEFAULT_VALUES
+from gymfx_tpu.telemetry.attribution import validate_profile_report
+from gymfx_tpu.telemetry.profiler import find_captures
+from gymfx_tpu.train.ppo import train_from_config
+
+with tempfile.TemporaryDirectory() as d:
+    prof = str(Path(d) / "prof")
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update({
+        # the CI reconciliation shape: large enough that device work
+        # dominates thunk overhead, small enough for sub-minute CI
+        "window_size": 32, "num_envs": 64, "ppo_horizon": 32,
+        "ppo_epochs": 2, "ppo_minibatches": 2,
+        "policy_kwargs": {"hidden": [64, 64]},
+        "train_total_steps": 64 * 32 * 2, "seed": 1,
+        "telemetry_profile_dir": prof,
+    })
+    train_from_config(cfg)
+    caps = find_captures(prof)
+    if not caps:
+        print("observatory smoke: no capture bundle written")
+        sys.exit(1)
+    out = subprocess.run(
+        [sys.executable, "tools/profile_report.py", caps[-1]],
+        capture_output=True, text=True,
+    )
+    if out.returncode != 0:
+        print("profile_report.py failed:", out.stdout, out.stderr)
+        sys.exit(1)
+    report_path = Path(caps[-1]) / "profile_report.json"
+    report = json.loads(report_path.read_text(encoding="utf-8"))
+    problems = validate_profile_report(report)
+    if problems:
+        print("PROFILE REPORT SCHEMA VIOLATIONS:", *problems, sep="\n  ")
+        sys.exit(1)
+    rec = report["reconciliation"]
+    meas = report["mfu_measured"]
+    assert rec["within_tolerance"], rec
+    assert meas["device_ms_per_step"] > 0, meas
+    assert meas["flops_per_step"] > 0 and meas["achieved_flops_per_sec"], meas
+    print(f"observatory smoke OK (trace rollout frac "
+          f"{rec['trace_rollout_frac']:.3f} vs split "
+          f"{rec['split_rollout_frac']:.3f}, "
+          f"{meas['achieved_flops_per_sec']:.3g} FLOP/s measured)")
+
+    # synthetic kernel regression: double the top kernel's per-step
+    # time in a copy of the real report — --compare must exit 1
+    worse = json.loads(report_path.read_text(encoding="utf-8"))
+    kernels = worse["trace"]["top_kernels"]
+    assert kernels, "report has no kernels to regress"
+    kernels[0]["total_ms_per_step"] *= 2.0
+    kernels[0]["total_ms"] *= 2.0
+    new_path = Path(d) / "regressed_report.json"
+    new_path.write_text(json.dumps(worse), encoding="utf-8")
+    rc = subprocess.run(
+        [sys.executable, "tools/profile_report.py", str(new_path),
+         "--compare", str(report_path), "--min-ms", "0"],
+        capture_output=True,
+    ).returncode
+    if rc != 1:
+        print(f"profile --compare did NOT flag a doubled kernel (rc={rc})")
+        sys.exit(1)
+    print("profile --compare correctly fails the synthetic kernel "
+          "regression")
+EOF
+echo "performance observatory smoke: rc=$profile_rc"
+
 # telemetry smoke + PROGRESS row (registry/http/sink are jax-free:
 # this is sub-second and runs even when the suite failed, so the row
 # records the failure too)
@@ -175,5 +260,8 @@ if [ "$sentinel_rc" -ne 0 ]; then
 fi
 if [ "$ledger_rc" -ne 0 ]; then
     exit "$ledger_rc"
+fi
+if [ "$profile_rc" -ne 0 ]; then
+    exit "$profile_rc"
 fi
 exit "$smoke_rc"

@@ -126,14 +126,6 @@ def _measure(trainer, n_envs: int, horizon: int, iters: int,
         params, num_envs=n_envs, horizon=horizon, update_epochs=epochs,
     )
 
-    if profile_dir is not None:
-        import jax.profiler
-
-        profile_dir.mkdir(parents=True, exist_ok=True)
-        with jax.profiler.trace(str(profile_dir)):
-            state, _ = step(state)
-            jax.block_until_ready(state)
-
     split = None
     # r6: the split times BOTH halves directly as donated-carry compiled
     # sub-programs (the _rollout_phase/_update_phase methods every
@@ -156,6 +148,41 @@ def _measure(trainer, n_envs: int, horizon: int, iters: int,
                 split["update_gemm_frac"] = round(
                     min(1.0, u_flops / flops), 4
                 )
+
+    if profile_dir is not None:
+        # managed capture of the SAME compiled executable (manifest
+        # with HLO scope map, FLOPs, phase split, comparability triple
+        # — read back with tools/profile_report.py)
+        from gymfx_tpu.telemetry.profiler import ProfilerSession
+
+        session = ProfilerSession(str(profile_dir))
+
+        def _profile_workload(it_start, k):
+            info = {
+                "algo": type(trainer).__name__, "n_envs": n_envs,
+                "horizon": horizon, "steps_per_iter": n_envs * horizon,
+                "xla_flops_per_dispatch": flops,
+                "xla_flops_per_step": flops,
+                "analytic_flops_per_step": analytic,
+                "phase_split": (
+                    {"rollout_ms": split["rollout_seconds_per_iter"] * 1e3,
+                     "update_ms": split["update_seconds_per_iter"] * 1e3,
+                     "iters": iters, "source": "measure_phase_split"}
+                    if split is not None else None
+                ),
+            }
+            try:
+                info["hlo_text"] = step.as_text()
+            except Exception:
+                pass
+            return info
+
+        session.set_workload_source(_profile_workload)
+        import jax
+
+        with session.capture(label="tpu_bench"):
+            state, _ = step(state)
+            jax.block_until_ready(state)
 
     import jax
 
