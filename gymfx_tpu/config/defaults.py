@@ -221,6 +221,16 @@ DEFAULT_VALUES = {
     # exceeds this many seconds, PolicyDecisionService decides via the
     # fallback policy instead of acting on a stale window.  null = off
     "feed_stale_after_s": None,
+    # ---- continuous deployment (docs/serving.md, "Hot-swap and
+    # blue/green"; docs/resilience.md) — only read when a
+    # BlueGreenDeployer / deploy controller is constructed; a plain
+    # engine + batcher session never touches these.
+    # pinned-obs rows per shadow-parity probe run against the standby
+    # engine before a promote flips routing; 0 disables the probe
+    "serve_swap_parity_probe": 4,
+    # run the scenario gate in --quick mode inside the deploy
+    # controller's train->gate->swap loop (full matrix when False)
+    "deploy_gate_quick": True,
 
     # ---- telemetry (gymfx_tpu/telemetry/, docs/observability.md) ----
     # ALL off by default: with every telemetry_* knob unset,

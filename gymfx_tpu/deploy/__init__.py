@@ -1,0 +1,18 @@
+"""Continuous-learning deployment: the train -> gate -> swap loop
+(docs/resilience.md, "Continuous-learning loop").
+
+:mod:`gymfx_tpu.serve.deploy` owns the serving-side mechanics (blue/
+green engines, hot-swap, verified rollback); this package owns the
+POLICY side — when a candidate is trained, how it is gated, what its
+failures feed back into, and when the live policy is demoted."""
+from gymfx_tpu.deploy.controller import (
+    ContinuousLearningController,
+    CycleResult,
+    controller_from_config,
+)
+
+__all__ = [
+    "ContinuousLearningController",
+    "CycleResult",
+    "controller_from_config",
+]

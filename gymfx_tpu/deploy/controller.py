@@ -1,0 +1,243 @@
+"""The continuous-learning control loop: train -> gate -> swap.
+
+Each cycle trains a candidate checkpoint, runs the scenario robustness
+gate (tools/scenario_gate.py) against it, and only on a clean gate
+promotes it into the live blue/green pair
+(:class:`~gymfx_tpu.serve.deploy.BlueGreenDeployer`).  A failed gate
+never touches routing — instead the FAILING presets become the next
+cycle's training curriculum (``feed=scengen`` on the failed preset),
+so the loop spends its training budget exactly where the candidate is
+weakest.  A post-promote regression signal demotes: ``policy_demote``
+is ledgered and the previous policy is restored with a bitwise-
+verified rollback.
+
+Every transition lands in the run ledger (``gate_verdict``,
+``policy_promote`` / ``policy_demote`` / ``policy_rollback``) and the
+metrics registry (``gymfx_policy_swaps_total``,
+``gymfx_policy_generation``) — the soak harness (tools/soak.py) runs
+this loop for N cycles under the fault grammar and audits exactly
+those records.
+
+``train_fn`` / ``gate_fn`` / ``regress_fn`` are injectable so tests
+and the quick CI soak can substitute sub-second stand-ins; the
+defaults are the real trainer (train/ppo.py) and the real gate.
+"""
+from __future__ import annotations
+
+import importlib.util
+from pathlib import Path
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    NamedTuple,
+    Optional,
+    Tuple,
+)
+
+__all__ = [
+    "ContinuousLearningController",
+    "CycleResult",
+    "controller_from_config",
+    "failed_presets",
+    "load_scenario_gate",
+]
+
+
+class CycleResult(NamedTuple):
+    """Outcome of one train->gate->swap cycle."""
+
+    cycle: int
+    checkpoint_dir: str
+    gate_passed: bool
+    failed_presets: Tuple[str, ...]
+    promoted: bool
+    demoted: bool
+    rollback_verified: Optional[bool]  # None when no rollback ran
+    generation: int                    # serving generation after the cycle
+    swap_latency_s: Optional[float]    # None when no flip happened
+
+
+def load_scenario_gate():
+    """Import tools/scenario_gate.py by path — it is an executable
+    script, not a package module, and the repo keeps it that way so it
+    drops into CI as a bare command."""
+    path = Path(__file__).resolve().parents[2] / "tools" / "scenario_gate.py"
+    spec = importlib.util.spec_from_file_location(
+        "gymfx_tpu_scenario_gate", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def failed_presets(report: Dict[str, Any]) -> Tuple[str, ...]:
+    """Presets whose gate row failed — the candidate's next curriculum."""
+    scenarios = report.get("scenarios") or {}
+    return tuple(
+        preset for preset, row in scenarios.items()
+        if isinstance(row, dict) and not row.get("passed", False)
+    )
+
+
+class ContinuousLearningController:
+    """Drives retrain->gate->swap cycles against one deployer.
+
+    Parameters
+    ----------
+    config : the merged config dict; each cycle trains a candidate from
+        a copy of it (with the curriculum and per-cycle checkpoint dir
+        applied)
+    deployer : a :class:`~gymfx_tpu.serve.deploy.BlueGreenDeployer`
+    train_fn : config -> summary dict carrying ``checkpoint_dir``
+        (default: :func:`gymfx_tpu.train.ppo.train_from_config`)
+    gate_fn : (config, checkpoint_dir) -> scenario-gate report dict
+        (default: ``run_gate`` from tools/scenario_gate.py, quick per
+        ``deploy_gate_quick``)
+    regress_fn : (deployer, CycleResult fields) -> bool; True demotes
+        the just-promoted policy (default: never)
+    ledger : telemetry RunLedger or None (``gate_verdict`` rows; the
+        deployer ledgers its own transitions)
+    """
+
+    def __init__(
+        self,
+        config: Dict[str, Any],
+        deployer: Any,
+        *,
+        train_fn: Optional[Callable[[Dict[str, Any]], Any]] = None,
+        gate_fn: Optional[Callable[..., Dict[str, Any]]] = None,
+        regress_fn: Optional[Callable[..., bool]] = None,
+        ledger: Optional[Any] = None,
+    ):
+        self.config = dict(config)
+        self.deployer = deployer
+        self.train_fn = train_fn if train_fn is not None else _default_train
+        self.gate_fn = gate_fn if gate_fn is not None else _default_gate
+        self.regress_fn = regress_fn
+        self.ledger = ledger
+        self.curriculum: Tuple[str, ...] = ()
+        self.results: List[CycleResult] = []
+
+    # ------------------------------------------------------------------
+    def _cycle_config(self, cycle: int, workdir: str) -> Dict[str, Any]:
+        cfg = dict(self.config)
+        # per-cycle checkpoint dir: each candidate gets its own tree so
+        # digests, audits and rollback targets never collide
+        cfg["checkpoint_dir"] = str(
+            Path(workdir) / f"candidate_{int(cycle):03d}"
+        )
+        if self.curriculum:
+            # the PR9 "remaining": a candidate that failed a preset
+            # trains on that preset next — rotate through the failures
+            preset = self.curriculum[int(cycle) % len(self.curriculum)]
+            cfg.update({
+                "feed": "scengen",
+                "scengen_preset": preset,
+                "scengen_seed": int(cfg.get("seed", 0) or 0) + int(cycle),
+            })
+        return cfg
+
+    def run_cycle(self, cycle: int, workdir: str) -> CycleResult:
+        cfg = self._cycle_config(cycle, workdir)
+        summary = self.train_fn(cfg) or {}
+        ckpt = str(
+            (summary.get("checkpoint_dir") if isinstance(summary, dict)
+             else None)
+            or cfg["checkpoint_dir"]
+        )
+
+        report = self.gate_fn(self.config, ckpt) or {}
+        passed = bool(report.get("passed", False))
+        failed = failed_presets(report)
+        if self.ledger is not None:
+            self.ledger.record(
+                "gate_verdict",
+                verdict="pass" if passed else "fail",
+                cycle=int(cycle),
+                failed_presets=list(failed),
+                checkpoint_dir=ckpt,
+            )
+
+        if not passed:
+            self.curriculum = failed
+            result = CycleResult(
+                cycle=int(cycle), checkpoint_dir=ckpt, gate_passed=False,
+                failed_presets=failed, promoted=False, demoted=False,
+                rollback_verified=None,
+                generation=self.deployer.generation, swap_latency_s=None,
+            )
+            self.results.append(result)
+            return result
+
+        self.curriculum = ()
+        promo = self.deployer.promote(ckpt)
+        demoted = False
+        rollback_verified: Optional[bool] = None
+        generation = promo.generation
+        if self.regress_fn is not None and self.regress_fn(
+            self.deployer, cycle=int(cycle), checkpoint_dir=ckpt
+        ):
+            rb = self.deployer.demote("regression")
+            demoted = True
+            rollback_verified = rb.verified
+            generation = rb.generation
+        result = CycleResult(
+            cycle=int(cycle), checkpoint_dir=ckpt, gate_passed=True,
+            failed_presets=(), promoted=True, demoted=demoted,
+            rollback_verified=rollback_verified, generation=generation,
+            swap_latency_s=promo.swap_latency_s,
+        )
+        self.results.append(result)
+        return result
+
+    def run(self, cycles: int, workdir: str) -> List[CycleResult]:
+        return [self.run_cycle(i, workdir) for i in range(int(cycles))]
+
+
+def _default_train(cfg: Dict[str, Any]) -> Any:
+    from gymfx_tpu.train.ppo import train_from_config
+
+    return train_from_config(cfg)
+
+
+def _default_gate(config: Dict[str, Any], checkpoint_dir: str,
+                  ) -> Dict[str, Any]:
+    gate = load_scenario_gate()
+    quick = bool(config.get("deploy_gate_quick", True))
+    return gate.run_gate(quick=quick, seed=int(config.get("seed", 0) or 0))
+
+
+def controller_from_config(
+    config: Dict[str, Any],
+    *,
+    instruments: Optional[Any] = None,
+    ledger: Optional[Any] = None,
+    registry: Optional[Any] = None,
+    wrap_engine: Optional[Callable[[Any], Any]] = None,
+    train_fn: Optional[Callable[[Dict[str, Any]], Any]] = None,
+    gate_fn: Optional[Callable[..., Dict[str, Any]]] = None,
+    regress_fn: Optional[Callable[..., bool]] = None,
+):
+    """One-call construction of the full loop: blue/green serving stack
+    (engines + batcher + deployer) plus the controller driving it.
+    Returns ``(controller, deploy_bundle)``."""
+    from gymfx_tpu.serve.deploy import bluegreen_from_config
+
+    db = bluegreen_from_config(
+        config,
+        instruments=instruments,
+        ledger=ledger,
+        registry=registry,
+        wrap_engine=wrap_engine,
+    )
+    controller = ContinuousLearningController(
+        config,
+        db.deployer,
+        train_fn=train_fn,
+        gate_fn=gate_fn,
+        regress_fn=regress_fn,
+        ledger=ledger,
+    )
+    return controller, db

@@ -4,13 +4,20 @@ The serving counterpart of the training stack: an AOT-compiled,
 shape-bucketed forward pass (:mod:`engine`), a micro-batching scheduler
 coalescing concurrent requests into one dispatch (:mod:`batcher`), and
 a per-session O(1) featurizer producing observations bit-identical to
-the training env's (:mod:`features`)."""
+the training env's (:mod:`features`), and blue/green hot-swap
+deployment over the compiled ladder (:mod:`deploy`)."""
 from gymfx_tpu.serve.batcher import (
     MicroBatcher,
     RequestRecord,
     batcher_from_config,
 )
 from gymfx_tpu.serve.config import ServeConfig, serve_config_from
+from gymfx_tpu.serve.deploy import (
+    BlueGreenDeployer,
+    DeployError,
+    ParityProbeError,
+    bluegreen_from_config,
+)
 from gymfx_tpu.serve.overload import (
     OVERLOAD_ERRORS,
     BatcherClosedError,
@@ -22,6 +29,7 @@ from gymfx_tpu.serve.engine import (
     Decision,
     EngineBundle,
     InferenceEngine,
+    WeightSwapError,
     engine_from_config,
     resolve_batch_mode,
 )
@@ -39,15 +47,20 @@ __all__ = [
     "BarFeaturizer",
     "BarSession",
     "BatcherClosedError",
+    "BlueGreenDeployer",
     "DeadlineExceeded",
     "Decision",
+    "DeployError",
     "EngineBundle",
     "InferenceEngine",
     "MicroBatcher",
+    "ParityProbeError",
     "RequestRecord",
     "ServeConfig",
     "ShedError",
+    "WeightSwapError",
     "batcher_from_config",
+    "bluegreen_from_config",
     "engine_from_config",
     "flatten_obs_host",
     "make_host_encoder",

@@ -157,6 +157,12 @@ class MicroBatcher:
         self._closed = False
         self._draining = False
         self._stop = False
+        # pause()/resume() handshake: _paused asks the worker to hold at
+        # the next micro-batch boundary; _parked is the worker's ack that
+        # it is idle there (owned by the worker, only ever flipped under
+        # the cv) — see pause() for the deployer flip protocol
+        self._paused = False
+        self._parked = False
         # optional telemetry (telemetry/instruments.ServeInstruments):
         # None keeps this batcher exactly as before — the plain-int
         # counters above are the only accounting on the off path
@@ -266,6 +272,7 @@ class MicroBatcher:
                 "coalesced_total": self.coalesced_total,
                 "max_queue": self.max_queue,
                 "draining": self._draining,
+                "paused": self._paused,
                 "closed": self._closed,
             }
         # with telemetry attached, fold the rolling SLO window in — the
@@ -274,6 +281,50 @@ class MicroBatcher:
         if self._instr is not None and self._instr.slo is not None:
             out["slo"] = self._instr.slo.rates()
         return out
+
+    def pause(self, timeout: Optional[float] = None) -> bool:
+        """Hold the worker at the next micro-batch boundary.
+
+        Returns True once the worker is provably parked: it has finished
+        any in-flight dispatch and is waiting BEFORE picking up the next
+        request — queued requests stay queued (no loss, no failure), and
+        admissions stay open.  The deployer flips ``self.engine`` inside
+        a pause()/resume() bracket so the flip can never race the
+        worker's pickup loop.
+
+        Bounded: with ``timeout`` (seconds) a pause that cannot park the
+        worker in time is rolled back (the queue keeps moving) and False
+        is returned.  ``timeout=None`` waits forever.  Raises
+        :class:`BatcherClosedError` on a closed batcher; pausing an
+        already-paused batcher returns True immediately."""
+        end = None if timeout is None else time.perf_counter() + timeout
+        with self._cv:
+            if self._closed or self._stop:
+                raise BatcherClosedError("cannot pause a closed MicroBatcher")
+            self._paused = True
+            self._cv.notify_all()
+            while not self._parked:
+                if self._stop:
+                    self._paused = False
+                    return False
+                if end is None:
+                    self._cv.wait()
+                else:
+                    remaining = end - time.perf_counter()
+                    if remaining <= 0:
+                        # failed pause must not wedge the queue
+                        self._paused = False
+                        self._cv.notify_all()
+                        return False
+                    self._cv.wait(remaining)
+            return True
+
+    def resume(self) -> None:
+        """Release a pause(); the worker re-checks the queue immediately.
+        Idempotent — resuming a batcher that is not paused is a no-op."""
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown, phase 1: stop admissions (submit raises
@@ -338,6 +389,16 @@ class MicroBatcher:
                 while True:
                     if self._stop:
                         return None
+                    # park point: only the OUTER pickup (timeout=None,
+                    # i.e. between micro-batches) honors pause — the
+                    # window-coalescing takes keep the current batch
+                    # intact so a pause can never split or drop it
+                    if end is None and self._paused:
+                        self._parked = True
+                        self._cv.notify_all()
+                        self._cv.wait()
+                        self._parked = False
+                        continue
                     if self._pending:
                         break
                     if end is None:
@@ -419,6 +480,10 @@ class MicroBatcher:
     def _dispatch(self, batch: List[_Pending], t_pickup: float) -> None:
         import jax
 
+        # one engine read per dispatch: the deployer may retarget
+        # self.engine between micro-batches (under pause()), and a batch
+        # must see exactly one engine end-to-end
+        engine = self.engine
         n = len(batch)
         if self.breaker is not None:
             try:
@@ -436,12 +501,12 @@ class MicroBatcher:
         obs = np.stack([p.obs for p in batch])
         carries = (
             jax.tree.map(lambda *xs: np.stack(xs), *[p.carry for p in batch])
-            if self.engine.recurrent
+            if engine.recurrent
             else None
         )
         t_dispatch = time.perf_counter()
         try:
-            out = self.engine.decide_batch(obs, carries)
+            out = engine.decide_batch(obs, carries)
         except BaseException as exc:
             # resolve every waiter with the fault and KEEP SERVING: one
             # poisoned dispatch must not stall the whole queue (the
@@ -458,7 +523,7 @@ class MicroBatcher:
         if self.breaker is not None:
             self.breaker.record_success()
         t_done = time.perf_counter()
-        bucket = self.engine.bucket_for(n)
+        bucket = engine.bucket_for(n)
         for i, p in enumerate(batch):
             _resolve_result(
                 p.future,
@@ -467,7 +532,7 @@ class MicroBatcher:
                     out.value[i],
                     out.actor_out[i],
                     jax.tree.map(lambda x: x[i], out.carry)
-                    if self.engine.recurrent
+                    if engine.recurrent
                     else out.carry,
                 ),
             )

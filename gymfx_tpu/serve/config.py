@@ -26,6 +26,8 @@ class ServeConfig(NamedTuple):
     breaker_threshold: int            # dispatch failures to trip; 0 = no breaker
     breaker_recovery_s: float         # open -> half-open window
     feed_stale_after_s: Optional[float]  # live stale-feed watchdog; None = off
+    # ---- continuous deployment (docs/serving.md, "Hot-swap") ----
+    swap_parity_probe: int            # pinned-obs rows per shadow-parity probe; 0 = off
 
 
 def _parse_buckets(value: Any) -> Tuple[int, ...]:
@@ -75,6 +77,11 @@ def serve_config_from(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve_breaker_recovery_s must be >= 0, got {recovery}"
         )
+    probe = int(config.get("serve_swap_parity_probe", 4) or 0)
+    if probe < 0:
+        raise ValueError(
+            f"serve_swap_parity_probe must be >= 0 (0 disables), got {probe}"
+        )
     return ServeConfig(
         buckets=_parse_buckets(config.get("serve_buckets")),
         max_batch_wait_ms=wait,
@@ -91,4 +98,5 @@ def serve_config_from(config: Dict[str, Any]) -> ServeConfig:
         breaker_threshold=threshold,
         breaker_recovery_s=recovery,
         feed_stale_after_s=_opt_positive(config, "feed_stale_after_s", float),
+        swap_parity_probe=probe,
     )

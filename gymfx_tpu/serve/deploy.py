@@ -1,0 +1,364 @@
+"""Blue/green policy deployment over the AOT serving ladder.
+
+Two warm :class:`~gymfx_tpu.serve.engine.InferenceEngine` instances —
+active and standby, compiled once at boot — sit behind the ONE
+:class:`~gymfx_tpu.serve.batcher.MicroBatcher`.  A promote loads a
+digest-verified checkpoint into the standby engine via
+``swap_weights`` (honor-or-reject: same shapes/dtypes or nothing, any
+late compile is a hard failure), shadow-probes it on a pinned
+observation batch, then flips the batcher's routing between
+micro-batches inside a ``pause()/resume()`` bracket — drain-free:
+queued and in-flight requests are never dropped, they simply land on
+whichever engine is active when their batch dispatches, and every
+batch sees exactly one engine end-to-end.
+
+The previous engine keeps its weights untouched and stays armed for
+:meth:`BlueGreenDeployer.rollback`, which flips routing back and then
+REPLAYS the pinned observations: rollback is only ``verified`` when
+the restored decision stream is bitwise equal to the pre-promotion
+snapshot (action, value, actor head and carry — exact bytes, not
+allclose).  Every transition is ledgered (``policy_promote`` /
+``policy_demote`` / ``policy_rollback``) and counted
+(``gymfx_policy_swaps_total`` by kind, ``gymfx_policy_generation``
+gauge).
+
+Lifecycle (docs/resilience.md has the full loop diagram)::
+
+    train -> gate -> promote(ckpt) --pass--> serve (generation N+1)
+                          |                     |
+                       reject               regress?
+                     (unchanged)                |
+                                        demote + rollback
+                                     (generation N, verified)
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import numpy as np
+
+from gymfx_tpu.serve.engine import InferenceEngine, WeightSwapError
+
+__all__ = [
+    "BlueGreenDeployer",
+    "DeployError",
+    "ParityProbeError",
+    "PromoteResult",
+    "RollbackResult",
+    "WeightSwapError",
+    "bluegreen_from_config",
+]
+
+
+class DeployError(RuntimeError):
+    """A deployment transition could not complete; serving is left on
+    the engine that was active before the attempt."""
+
+
+class ParityProbeError(DeployError):
+    """The standby engine failed the pinned-obs shadow-parity probe
+    (non-finite outputs, or two runs of the same batch disagreed) —
+    the flip never happened."""
+
+
+class PromoteResult(NamedTuple):
+    generation: int       # serving generation after the flip
+    step: int             # checkpoint step promoted
+    digest: Optional[str] # its sha256 (None for legacy saves)
+    swap_latency_s: float # pause -> flip -> resume wall time
+
+
+class RollbackResult(NamedTuple):
+    generation: int       # serving generation after the rollback
+    verified: bool        # pinned-obs replay bitwise equal to snapshot
+
+
+def _decision_bytes(decision: Any) -> bytes:
+    """Canonical byte string of a Decision (order-stable over the tree
+    leaves) — equality of these IS bitwise equality of the decision
+    stream on the pinned batch."""
+    import jax
+
+    parts = []
+    for leaf in jax.tree.leaves(tuple(decision)):
+        arr = np.asarray(leaf)
+        parts.append(str(arr.dtype).encode())
+        parts.append(str(arr.shape).encode())
+        parts.append(arr.tobytes())
+    return b"\0".join(parts)
+
+
+def _all_finite(decision: Any) -> bool:
+    import jax
+
+    for leaf in jax.tree.leaves(tuple(decision)):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not bool(
+            np.all(np.isfinite(arr.astype(np.float64)))
+        ):
+            return False
+    return True
+
+
+class BlueGreenDeployer:
+    """Active+standby engine pair behind one micro-batcher.
+
+    Parameters
+    ----------
+    active, standby : warm engines compiled for the SAME policy family,
+        bucket ladder and batch mode (the builder guarantees this)
+    batcher : the serving MicroBatcher currently targeting ``active``
+        (None is allowed for engine-only tests; flips then skip the
+        pause bracket)
+    parity_probe_rows : pinned-obs rows for the shadow probe and the
+        rollback replay (``serve_swap_parity_probe``); 0 disables the
+        pre-flip probe but keeps a 1-row pinned batch so rollback can
+        still verify
+    ledger : telemetry RunLedger or None
+    registry : telemetry MetricsRegistry or None
+    wrap_engine : callable applied to an engine as it is installed into
+        the batcher (identity by default) — the soak harness wraps with
+        FlakyEngine here so fault injection follows the ACTIVE engine
+        across flips
+    pause_timeout_s : bound on how long a flip may wait for the worker
+        to park; exceeding it raises :class:`DeployError` with routing
+        untouched
+    """
+
+    def __init__(
+        self,
+        active: InferenceEngine,
+        standby: InferenceEngine,
+        batcher: Optional[Any] = None,
+        *,
+        parity_probe_rows: int = 4,
+        ledger: Optional[Any] = None,
+        registry: Optional[Any] = None,
+        wrap_engine: Optional[Callable[[Any], Any]] = None,
+        pause_timeout_s: float = 30.0,
+        seed: int = 0,
+    ):
+        if active.obs_shape != standby.obs_shape:
+            raise DeployError(
+                f"active/standby obs shapes differ: {active.obs_shape} "
+                f"vs {standby.obs_shape}"
+            )
+        self.active = active
+        self.standby = standby
+        self.batcher = batcher
+        self.parity_probe_rows = int(parity_probe_rows)
+        if self.parity_probe_rows < 0:
+            raise ValueError(
+                f"parity_probe_rows must be >= 0, got {parity_probe_rows}"
+            )
+        self.ledger = ledger
+        self.pause_timeout_s = float(pause_timeout_s)
+        self._wrap = wrap_engine if wrap_engine is not None else (lambda e: e)
+        self.generation = 0          # serving generation (0 = boot policy)
+        self.promote_count = 0
+        self.active_digest: Optional[str] = None
+        self.active_step: Optional[int] = None
+        self._rollback: Optional[Dict[str, Any]] = None
+        # pinned observation batch: the deployment-long fixture every
+        # shadow probe and rollback replay runs against (seeded, so two
+        # deployers with the same seed pin the same batch)
+        rows = max(1, self.parity_probe_rows)
+        rng = np.random.default_rng(int(seed))
+        self._pinned_obs = rng.standard_normal(
+            (rows, *active.obs_shape)
+        ).astype(active.obs_dtype)
+        self._swaps = self._generation_gauge = None
+        if registry is not None:
+            self._swaps = registry.counter(
+                "gymfx_policy_swaps_total",
+                "blue/green policy transitions by kind",
+                labels=("kind",),
+            )
+            self._generation_gauge = registry.gauge(
+                "gymfx_policy_generation",
+                "serving policy generation (0 = boot policy)",
+            )
+            self._generation_gauge.set(0.0)
+        if batcher is not None:
+            # install through the wrap hook so boot and post-flip
+            # serving go through the same instrumentation
+            batcher.engine = self._wrap(active)
+
+    # ------------------------------------------------------------------
+    def _decide_pinned(self, engine: InferenceEngine) -> Any:
+        carries = (
+            engine.initial_carry_batch(self._pinned_obs.shape[0])
+            if engine.recurrent
+            else None
+        )
+        return engine.decide_batch(self._pinned_obs, carries)
+
+    def _parity_probe(self, engine: InferenceEngine) -> None:
+        if self.parity_probe_rows < 1:
+            return
+        first = self._decide_pinned(engine)
+        if not _all_finite(first):
+            raise ParityProbeError(
+                "standby engine produced non-finite outputs on the "
+                "pinned observation batch — flip aborted"
+            )
+        second = self._decide_pinned(engine)
+        if _decision_bytes(first) != _decision_bytes(second):
+            raise ParityProbeError(
+                "standby engine is non-deterministic on the pinned "
+                "observation batch (two runs disagree bitwise) — "
+                "flip aborted"
+            )
+
+    def _flip(self, engine: InferenceEngine) -> float:
+        """Retarget the batcher at ``engine`` between micro-batches.
+        Returns the pause->resume wall time (the swap latency)."""
+        t0 = time.perf_counter()
+        if self.batcher is None:
+            return time.perf_counter() - t0
+        if not self.batcher.pause(self.pause_timeout_s):
+            raise DeployError(
+                f"could not park the batcher worker within "
+                f"{self.pause_timeout_s}s — routing unchanged"
+            )
+        try:
+            self.batcher.engine = self._wrap(engine)
+        finally:
+            self.batcher.resume()
+        return time.perf_counter() - t0
+
+    def _record(self, kind: str, **fields: Any) -> None:
+        if self.ledger is not None:
+            self.ledger.record(kind, **fields)
+        if self._swaps is not None:
+            self._swaps.inc(kind=kind.replace("policy_", ""))
+        if self._generation_gauge is not None:
+            self._generation_gauge.set(float(self.generation))
+
+    # ------------------------------------------------------------------
+    def promote(self, checkpoint_dir: str) -> PromoteResult:
+        """Digest-verify + load ``checkpoint_dir``'s newest step into
+        the standby engine, shadow-probe it, and flip routing to it.
+
+        Raises before any routing change on: a failed digest
+        (:class:`~gymfx_tpu.train.checkpoint.CheckpointIntegrityError`),
+        a shape/dtype/tree mismatch (:class:`WeightSwapError` — the
+        ladder only accepts same-signature weights), or a failed parity
+        probe (:class:`ParityProbeError`).  On success the PREVIOUS
+        engine stays armed for :meth:`rollback`."""
+        from gymfx_tpu.train.checkpoint import load_params, verify_checkpoint
+
+        step, digest = verify_checkpoint(str(checkpoint_dir))
+        params, loaded_step = load_params(str(checkpoint_dir))
+        step = int(loaded_step if loaded_step else step)
+
+        # pre-promotion snapshot: what the CURRENT policy says on the
+        # pinned batch — the bitwise reference a rollback must restore
+        snapshot = _decision_bytes(self._decide_pinned(self.active))
+
+        self.standby.swap_weights(params)       # honor-or-reject
+        self._parity_probe(self.standby)
+
+        swap_latency_s = self._flip(self.standby)
+        previous = self.active
+        self.active, self.standby = self.standby, previous
+        self._rollback = {
+            "engine": previous,
+            "snapshot": snapshot,
+            "digest": self.active_digest,
+            "step": self.active_step,
+            "generation": self.generation,
+        }
+        self.generation += 1
+        self.promote_count += 1
+        self.active_digest, self.active_step = digest, step
+        self._record(
+            "policy_promote",
+            generation=self.generation,
+            digest=digest,
+            step=step,
+            swap_latency_s=swap_latency_s,
+        )
+        return PromoteResult(self.generation, step, digest, swap_latency_s)
+
+    @property
+    def rollback_armed(self) -> bool:
+        return self._rollback is not None
+
+    def rollback(self) -> RollbackResult:
+        """Flip routing back to the pre-promotion engine and verify:
+        replay the pinned observations and compare bitwise against the
+        snapshot taken just before the promote.  Raises
+        :class:`DeployError` when no rollback is armed."""
+        armed = self._rollback
+        if armed is None:
+            raise DeployError("no previous policy armed for rollback")
+        self._flip(armed["engine"])
+        self.standby = self.active
+        self.active = armed["engine"]
+        self.generation = int(armed["generation"])
+        self.active_digest = armed["digest"]
+        self.active_step = armed["step"]
+        replay = _decision_bytes(self._decide_pinned(self.active))
+        verified = replay == armed["snapshot"]
+        self._rollback = None
+        self._record(
+            "policy_rollback", generation=self.generation, verified=verified
+        )
+        return RollbackResult(self.generation, verified)
+
+    def demote(self, reason: str) -> RollbackResult:
+        """Ledger a regression (``policy_demote``) and roll back."""
+        self._record(
+            "policy_demote", generation=self.generation, reason=str(reason)
+        )
+        return self.rollback()
+
+
+class DeployBundle(NamedTuple):
+    """A ready blue/green serving stack from one config dict."""
+
+    deployer: BlueGreenDeployer
+    batcher: Any
+    bundle: Any      # the active engine's EngineBundle (env, encoder, ...)
+
+
+def bluegreen_from_config(
+    config: Dict[str, Any],
+    *,
+    env: Optional[Any] = None,
+    instruments: Optional[Any] = None,
+    ledger: Optional[Any] = None,
+    registry: Optional[Any] = None,
+    wrap_engine: Optional[Callable[[Any], Any]] = None,
+) -> DeployBundle:
+    """Build active+standby engines (both warm, identical boot weights)
+    plus the micro-batcher and deployer from the merged config dict —
+    the construction path tools/soak.py and the deploy controller
+    share.  A session that never constructs a deployer pays none of
+    this: ``engine_from_config`` + ``batcher_from_config`` are
+    untouched."""
+    from gymfx_tpu.serve.batcher import batcher_from_config
+    from gymfx_tpu.serve.config import serve_config_from
+    from gymfx_tpu.serve.engine import engine_from_config
+
+    scfg = serve_config_from(config)
+    bundle = engine_from_config(config, env=env)
+    standby = engine_from_config(
+        config, env=bundle.env, params=bundle.engine.params
+    )
+    batcher = batcher_from_config(
+        bundle.engine, config, instruments=instruments
+    )
+    deployer = BlueGreenDeployer(
+        bundle.engine,
+        standby.engine,
+        batcher,
+        parity_probe_rows=scfg.swap_parity_probe,
+        ledger=ledger,
+        registry=registry,
+        wrap_engine=wrap_engine,
+        seed=int(config.get("seed", 0) or 0),
+    )
+    return DeployBundle(deployer=deployer, batcher=batcher, bundle=bundle)

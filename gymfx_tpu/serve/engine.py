@@ -45,6 +45,13 @@ import numpy as np
 DEFAULT_BUCKETS: Tuple[int, ...] = (1, 8, 64, 512, 4096)
 
 
+class WeightSwapError(RuntimeError):
+    """A hot-swap was rejected (shape/dtype/tree mismatch against the
+    compiled ladder, or a late compile during the swap probe).  The
+    engine keeps serving the previous weights — a rejected swap is
+    never destructive."""
+
+
 class Decision(NamedTuple):
     """One response row.  ``actor_out`` is the raw actor head output —
     logits ``(n_actions,)`` for discrete policies, the Gaussian mean for
@@ -182,6 +189,8 @@ class InferenceEngine:
         # owns the one dispatch thread in the serving topology anyway)
         self._lock = threading.Lock()
         self.late_compiles = 0  # compiles after boot — a warm engine has 0
+        self.generation = 0     # bumped on every accepted swap_weights
+        self.swap_count = 0
         # compile-watch hook: called as on_compile(bucket, duration_s,
         # late) after every bucket compile (CompileWatch.watch_engine
         # attaches it; None costs nothing)
@@ -232,6 +241,73 @@ class InferenceEngine:
     @property
     def executable_count(self) -> int:
         return len(self._compiled)
+
+    # ------------------------------------------------------------------
+    def swap_weights(self, params: Any, *, probe: bool = True) -> int:
+        """Hot-swap the served weights without recompiling the ladder.
+
+        Honor-or-reject: the candidate must match the compiled
+        executables' calling convention exactly — same pytree structure,
+        same per-leaf shape and dtype — or :class:`WeightSwapError` is
+        raised and the engine keeps serving the previous weights.  The
+        flip itself happens under the dispatch lock, so every in-flight
+        ``decide_batch`` completes against exactly one weight set (the
+        executables never donate the params argument — donation covers
+        obs/carry only — so the old weights stay valid until the last
+        dispatch holding them returns).
+
+        With ``probe=True`` (default) the smallest compiled bucket is
+        dispatched once against the new weights while the lock is held;
+        any exception or late compile during the probe restores the old
+        params and raises — a swap can never leave the ladder cold.
+
+        Returns the new generation number (monotonic, starts at 0).
+        """
+        import jax
+
+        new_leaves, new_tree = jax.tree.flatten(params)
+        cur_leaves, cur_tree = jax.tree.flatten(self.params)
+        if new_tree != cur_tree:
+            raise WeightSwapError(
+                f"params tree structure mismatch: engine serves "
+                f"{cur_tree}, candidate is {new_tree}"
+            )
+        for i, (new, cur) in enumerate(zip(new_leaves, cur_leaves)):
+            ns, nd = _leaf_signature(new)
+            cs, cd = _leaf_signature(cur)
+            if ns != cs or nd != cd:
+                raise WeightSwapError(
+                    f"params leaf {i} mismatch: engine serves "
+                    f"shape={cs} dtype={cd}, candidate has "
+                    f"shape={ns} dtype={nd} — same-shape swaps only "
+                    f"(the AOT ladder is compiled for one signature)"
+                )
+        new_params = jax.device_put(params)  # transfer outside the lock
+        with self._lock:
+            old_params = self.params
+            before = self.late_compiles
+            self.params = new_params
+            if probe and self._compiled:
+                bucket = min(self._compiled)
+                try:
+                    out = self._dispatch(*self._zero_batch(bucket), bucket)
+                    jax.block_until_ready(out)
+                except Exception as exc:
+                    self.params = old_params
+                    raise WeightSwapError(
+                        f"swap probe dispatch failed on bucket {bucket}: "
+                        f"{exc}"
+                    ) from exc
+                if self.late_compiles != before:
+                    self.params = old_params
+                    raise WeightSwapError(
+                        "late compile during weight swap — the candidate "
+                        "does not fit the compiled ladder (hard failure "
+                        "by contract; previous weights restored)"
+                    )
+            self.generation += 1
+            self.swap_count += 1
+            return self.generation
 
     def bucket_for(self, n: int) -> int:
         """Smallest ladder bucket covering ``n`` requests (the largest
@@ -356,6 +432,16 @@ class InferenceEngine:
             if self.recurrent
             else out.carry,
         )
+
+
+def _leaf_signature(leaf: Any) -> Tuple[Tuple[int, ...], str]:
+    """(shape, dtype-name) of a params leaf without forcing a host copy
+    — works for jax arrays (incl. bfloat16), numpy, and python scalars."""
+    shape = tuple(int(s) for s in getattr(leaf, "shape", np.shape(leaf)))
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        dtype = np.asarray(leaf).dtype
+    return shape, str(dtype)
 
 
 def _fill_rows(full: np.ndarray, got: np.ndarray, n: int) -> np.ndarray:

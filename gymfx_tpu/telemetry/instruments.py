@@ -171,8 +171,14 @@ class ServeInstruments:
                 "0 forever; monotonic, read at scrape time)",
                 labels=("batcher",),
             )
+            # read through the batcher at scrape time: the blue/green
+            # deployer retargets batcher.engine between micro-batches,
+            # and the gauge must follow the ACTIVE engine across flips
             late.set_function(
-                lambda e=engine: float(e.late_compiles), batcher=self.name
+                lambda b=batcher: float(
+                    getattr(b.engine, "late_compiles", 0)
+                ),
+                batcher=self.name,
             )
         if batcher.breaker is not None:
             from gymfx_tpu.telemetry.registry import register_resilience

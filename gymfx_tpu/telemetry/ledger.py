@@ -47,6 +47,9 @@ EVENT_KINDS = (
     "serve_bucket_miss",
     "postmortem_dump",
     "profile_capture",
+    "policy_promote",
+    "policy_demote",
+    "policy_rollback",
 )
 
 

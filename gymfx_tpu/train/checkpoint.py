@@ -120,6 +120,99 @@ def verify_checkpoint_step(directory: str, step: int) -> bool:
     return True
 
 
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint step failed sha256 digest verification — torn write,
+    bit rot, or tampering.  Raised by :func:`verify_checkpoint` (the
+    deployer's pre-promote gate) so a corrupt candidate is rejected
+    BEFORE any weights are loaded or routing is touched."""
+
+
+def _list_steps(path: Path) -> List[int]:
+    if not path.is_dir():
+        return []
+    return sorted(
+        int(p.name) for p in path.iterdir()
+        if p.is_dir() and p.name.isdigit()
+    )
+
+
+def verify_checkpoint(
+    directory: str, step: Optional[int] = None
+) -> Tuple[int, Optional[str]]:
+    """Digest-verify one checkpoint step WITHOUT restoring any tensors.
+
+    ``step=None`` picks the newest step under ``directory``.  Returns
+    ``(step, digest)`` on success — ``digest`` is the recorded sha256
+    hex, or None for a legacy save with no sidecar (accepted, per the
+    restore-path contract).  Raises :class:`CheckpointIntegrityError`
+    when the recomputed digest disagrees with the sidecar, and
+    ``FileNotFoundError`` when the step (or any step) is absent.
+    """
+    path = Path(directory).resolve()
+    steps = _list_steps(path)
+    if step is None:
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint steps under {path}")
+        step = steps[-1]
+    step = int(step)
+    if step not in steps:
+        raise FileNotFoundError(
+            f"checkpoint step {step} not found under {path} "
+            f"(available: {steps or 'none'})"
+        )
+    sidecar = _digest_sidecar(path, step)
+    if not sidecar.exists():
+        return step, None
+    if not verify_checkpoint_step(str(path), step):
+        raise CheckpointIntegrityError(
+            f"checkpoint step {step} under {path} failed sha256 digest "
+            f"verification — refusing to use it"
+        )
+    recorded = json.loads(sidecar.read_text())
+    return step, str(recorded.get("digest"))
+
+
+def audit_checkpoint_tree(directory: str) -> List[Dict[str, Any]]:
+    """Digest-audit every step under a checkpoint directory — no orbax
+    restore, no tensor I/O beyond hashing bytes.  One row per step (and
+    per ORPHANED digest sidecar whose step dir is gone):
+
+        {"step", "verified", "legacy", "digest", "files"}
+
+    ``legacy`` marks steps saved before the digest format (no sidecar;
+    verified=True by the restore-path contract).  The operator CLI is
+    ``tools/checkpoint_audit.py``."""
+    path = Path(directory).resolve()
+    steps = _list_steps(path)
+    sidecar_steps = set()
+    if path.is_dir():
+        for f in path.glob("digest_*.json"):
+            suffix = f.stem.split("_", 1)[-1]
+            if suffix.isdigit():
+                sidecar_steps.add(int(suffix))
+    rows: List[Dict[str, Any]] = []
+    for step in sorted(set(steps) | sidecar_steps):
+        sidecar = _digest_sidecar(path, step)
+        if not sidecar.exists():
+            rows.append({
+                "step": step, "verified": True, "legacy": True,
+                "digest": None, "files": None,
+            })
+            continue
+        try:
+            recorded = json.loads(sidecar.read_text())
+        except (OSError, ValueError):
+            recorded = {}
+        rows.append({
+            "step": step,
+            "verified": verify_checkpoint_step(str(path), step),
+            "legacy": False,
+            "digest": recorded.get("digest"),
+            "files": recorded.get("files"),
+        })
+    return rows
+
+
 def _is_empty(x: Any) -> bool:
     return hasattr(x, "shape") and math.prod(x.shape) == 0
 

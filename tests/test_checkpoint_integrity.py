@@ -10,8 +10,11 @@ import numpy as np
 import pytest
 
 from gymfx_tpu.train.checkpoint import (
+    CheckpointIntegrityError,
+    audit_checkpoint_tree,
     load_checkpoint,
     save_checkpoint,
+    verify_checkpoint,
     verify_checkpoint_step,
 )
 
@@ -101,3 +104,60 @@ def test_composite_save_digest_covers_both_items(tmp_path):
     assert verify_checkpoint_step(d, 3) is True
     _corrupt_one_file(tmp_path / "ckpt", 3)
     assert verify_checkpoint_step(d, 3) is False
+
+
+# ----------------------------------------------------------------------
+# verify_checkpoint — the honor-or-reject check the deployer runs
+# before every promote
+
+
+def test_verify_checkpoint_picks_newest_step_and_returns_digest(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(9), step=1)
+    save_checkpoint(d, _tree(10), step=12)
+    step, digest = verify_checkpoint(d)
+    assert step == 12
+    assert digest == json.loads(
+        (tmp_path / "ckpt" / "digest_12.json").read_text()
+    )["digest"]
+    step, digest = verify_checkpoint(d, step=1)  # explicit pin wins
+    assert step == 1 and digest
+
+
+def test_verify_checkpoint_raises_on_tamper_and_missing(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(11), step=4)
+    _corrupt_one_file(tmp_path / "ckpt", 4)
+    with pytest.raises(CheckpointIntegrityError):
+        verify_checkpoint(d)
+    with pytest.raises(FileNotFoundError):
+        verify_checkpoint(str(tmp_path / "nowhere"))
+    with pytest.raises(FileNotFoundError):
+        verify_checkpoint(d, step=99)
+
+
+def test_verify_checkpoint_accepts_legacy_without_sidecar(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(12), step=2)
+    (tmp_path / "ckpt" / "digest_2.json").unlink()
+    step, digest = verify_checkpoint(d)
+    assert step == 2 and digest is None  # accepted, flagged legacy
+
+
+def test_audit_checkpoint_tree_reports_every_step_and_orphans(tmp_path):
+    d = str(tmp_path / "ckpt")
+    save_checkpoint(d, _tree(13), step=1)
+    save_checkpoint(d, _tree(14), step=2)
+    save_checkpoint(d, _tree(15), step=3)
+    _corrupt_one_file(tmp_path / "ckpt", 2)
+    (tmp_path / "ckpt" / "digest_3.json").unlink()  # legacy step
+    # an orphaned sidecar whose step dir is gone must surface too
+    (tmp_path / "ckpt" / "digest_8.json").write_text(
+        json.dumps({"algo": "sha256", "digest": "dead", "files": 1})
+    )
+    rows = {r["step"]: r for r in audit_checkpoint_tree(d)}
+    assert set(rows) == {1, 2, 3, 8}
+    assert rows[1]["verified"] is True and not rows[1]["legacy"]
+    assert rows[2]["verified"] is False
+    assert rows[3]["verified"] is True and rows[3]["legacy"] is True
+    assert rows[8]["verified"] is False  # orphan: sidecar, no step dir

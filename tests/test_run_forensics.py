@@ -113,6 +113,44 @@ def test_ledger_schema_covers_every_emitter_kind():
     assert set(EVENT_KINDS) == set(schema["kinds"])
 
 
+def test_policy_transition_kinds_pin_their_required_keys(tmp_path):
+    """The blue/green deployer's lifecycle rows (serve/deploy.py) are
+    first-class ledger vocabulary: each transition kind has pinned
+    required keys, and a row missing them is drift."""
+    schema = load_ledger_schema()
+    assert schema["kinds"]["policy_promote"]["required"] == [
+        "generation", "digest",
+    ]
+    assert schema["kinds"]["policy_demote"]["required"] == [
+        "generation", "reason",
+    ]
+    assert schema["kinds"]["policy_rollback"]["required"] == [
+        "generation", "verified",
+    ]
+
+    led = RunLedger(str(tmp_path / "ledger.jsonl"))
+    assert led.record("policy_promote", generation=1, digest="abc123",
+                      step=7, swap_latency_s=0.002)
+    assert led.record("policy_demote", generation=1, reason="regression")
+    assert led.record("policy_rollback", generation=0, verified=True)
+    led.close()
+    assert validate_ledger(led.path) == []
+
+    base = {"ts": 1.0, "config_sha256": None, "schema_version": 1}
+    for kind, keys in (
+        ("policy_promote", ("generation", "digest")),
+        ("policy_demote", ("generation", "reason")),
+        ("policy_rollback", ("generation", "verified")),
+    ):
+        for dropped in keys:
+            row = {"seq": 1, "kind": kind, **base,
+                   **{k: 1 for k in keys if k != dropped}}
+            assert any(
+                f"missing required key '{dropped}'" in p
+                for p in validate_ledger_rows([row], schema)
+            ), f"{kind} row missing {dropped!r} not flagged"
+
+
 def test_config_digest_is_canonical():
     a = config_digest({"b": 2, "a": 1})
     b = config_digest({"a": 1, "b": 2})

@@ -1,0 +1,380 @@
+"""Weight hot-swap + blue/green deployment (gymfx_tpu/serve/deploy.py).
+
+The deployment contract (docs/serving.md, "Hot-swap and blue/green"):
+swapping to identical params changes no bits; a candidate that does
+not match the compiled ladder's signature is rejected with the old
+weights intact and ZERO late compiles; a swap under concurrent
+decide_batch load never mixes weight sets; promote flips routing
+drain-free between micro-batches; rollback restores the decision
+stream bitwise on a pinned obs replay.
+"""
+import threading
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gymfx_tpu.serve.batcher import MicroBatcher
+from gymfx_tpu.serve.deploy import (
+    BlueGreenDeployer,
+    DeployError,
+    ParityProbeError,
+)
+from gymfx_tpu.serve.engine import InferenceEngine, WeightSwapError
+from gymfx_tpu.train.checkpoint import (
+    CheckpointIntegrityError,
+    save_checkpoint,
+)
+from gymfx_tpu.train.policies import make_trainer_policy
+
+OBS_DIM = 10
+BUCKETS = (1, 4)
+
+
+def _policy():
+    return make_trainer_policy(
+        "mlp", continuous=False, dtype=jnp.float32,
+        kwargs={"hidden": [16, 16]}, window=4,
+    )
+
+
+def _params(pol, seed):
+    example = np.zeros((OBS_DIM,), np.float32)
+    return pol.init(jax.random.PRNGKey(seed), jnp.asarray(example))
+
+
+def _engine(pol, params, buckets=BUCKETS):
+    example = np.zeros((OBS_DIM,), np.float32)
+    return InferenceEngine(
+        pol, params, example, buckets=buckets, batch_mode="exact"
+    )
+
+
+def _obs(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (n, OBS_DIM)
+    ).astype(np.float32)
+
+
+def _bytes(decision):
+    return b"".join(np.asarray(x).tobytes() for x in decision[:3])
+
+
+# ----------------------------------------------------------------------
+# swap_weights semantics
+
+
+def test_swap_to_identical_params_is_bitwise_noop():
+    pol = _policy()
+    params = _params(pol, 0)
+    eng = _engine(pol, params)
+    obs = _obs(3, seed=1)
+    before = eng.decide_batch(obs)
+    gen = eng.swap_weights(params)
+    after = eng.decide_batch(obs)
+    assert gen == 1 and eng.swap_count == 1
+    assert _bytes(before) == _bytes(after)
+    assert eng.late_compiles == 0
+
+
+def test_swap_honor_or_reject_shape_dtype_tree():
+    pol = _policy()
+    params = _params(pol, 0)
+    eng = _engine(pol, params)
+    obs = _obs(2, seed=2)
+    reference = _bytes(eng.decide_batch(obs))
+
+    # shape mismatch
+    truncated = jax.tree.map(
+        lambda x: x[..., :1] if getattr(x, "ndim", 0) else x, params
+    )
+    with pytest.raises(WeightSwapError, match="shape"):
+        eng.swap_weights(truncated)
+
+    # dtype mismatch
+    widened = jax.tree.map(lambda x: np.asarray(x, np.float64), params)
+    with pytest.raises(WeightSwapError, match="dtype"):
+        eng.swap_weights(widened)
+
+    # tree-structure mismatch
+    with pytest.raises(WeightSwapError, match="tree structure"):
+        eng.swap_weights(jax.tree.leaves(params))
+
+    # the engine kept serving the ORIGINAL weights, with no recompiles
+    assert _bytes(eng.decide_batch(obs)) == reference
+    assert eng.late_compiles == 0
+    assert eng.generation == 0
+
+
+def test_swap_under_concurrent_load_never_mixes_weight_sets():
+    """Seeded thread hammer: while the main thread swaps A<->B 50
+    times, every concurrent decide_batch response must equal pure-A or
+    pure-B bitwise — never a blend — and the ladder never recompiles
+    (gymfx_serve_late_compiles_total scrapes 0 throughout)."""
+    from gymfx_tpu.telemetry import MetricsRegistry
+    from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+    pol = _policy()
+    params_a = _params(pol, 0)
+    params_b = _params(pol, 1)
+    eng = _engine(pol, params_a)
+    registry = MetricsRegistry()
+    instr = ServeInstruments(registry, name="hammer")
+    mb = MicroBatcher(eng, max_batch_wait_ms=0.0, instruments=instr)
+
+    obs = _obs(4, seed=3)
+    ref_a = _bytes(_engine(pol, params_a).decide_batch(obs))
+    ref_b = _bytes(_engine(pol, params_b).decide_batch(obs))
+    assert ref_a != ref_b  # distinct policies, or the test proves nothing
+
+    stop = threading.Event()
+    mixed = []
+
+    def hammer(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            got = _bytes(eng.decide_batch(obs))
+            if got not in (ref_a, ref_b):
+                mixed.append(got)
+                return
+            if rng.random() < 0.1:  # jitter the interleaving
+                threading.Event().wait(0.001)
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(50):
+            eng.swap_weights(params_b if i % 2 == 0 else params_a)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not mixed, "a decide_batch saw a blended weight set"
+    assert eng.swap_count == 50
+    assert eng.late_compiles == 0
+    late = registry.gauge(
+        "gymfx_serve_late_compiles_total", "", labels=("batcher",)
+    )
+    assert late.value(batcher="hammer") == 0.0
+    mb.close()
+
+
+# ----------------------------------------------------------------------
+# BlueGreenDeployer
+
+
+def _deploy_pair(ledger=None, registry=None, probe_rows=4):
+    pol = _policy()
+    params = _params(pol, 0)
+    active = _engine(pol, params)
+    standby = _engine(pol, params)
+    mb = MicroBatcher(active, max_batch_wait_ms=0.2)
+    dep = BlueGreenDeployer(
+        active, standby, mb, parity_probe_rows=probe_rows,
+        ledger=ledger, registry=registry, seed=5,
+    )
+    return pol, dep, mb
+
+
+def test_promote_flip_rollback_restores_bits_with_live_traffic(tmp_path):
+    pol, dep, mb = _deploy_pair()
+    candidate = jax.tree.map(lambda x: x + 0.25, dep.active.params)
+    ckpt = str(tmp_path / "cand")
+    save_checkpoint(ckpt, candidate, step=7)
+
+    obs = _obs(1, seed=6)[0]
+    before = mb.submit(obs).result(timeout=30)
+
+    # live traffic races the flip: every request must resolve
+    futures = []
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            futures.append(mb.submit(obs))
+
+    t = threading.Thread(target=client)
+    t.start()
+    try:
+        res = dep.promote(ckpt)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert res.generation == 1 and res.step == 7 and res.digest
+    assert res.swap_latency_s >= 0.0
+    for f in futures:  # drain-free flip: nothing dropped, nothing failed
+        assert f.result(timeout=30) is not None
+
+    after = mb.submit(obs).result(timeout=30)
+    assert _bytes(before) != _bytes(after)  # the new policy is serving
+
+    assert dep.rollback_armed
+    rb = dep.rollback()
+    assert rb.verified is True and rb.generation == 0
+    restored = mb.submit(obs).result(timeout=30)
+    assert _bytes(restored) == _bytes(before)  # bitwise restoration
+    assert not dep.rollback_armed
+    with pytest.raises(DeployError, match="rollback"):
+        dep.rollback()
+    assert dep.active.late_compiles == 0
+    assert dep.standby.late_compiles == 0
+    mb.close()
+
+
+def test_promote_rejects_tampered_checkpoint_before_touching_routing(
+        tmp_path):
+    pol, dep, mb = _deploy_pair()
+    candidate = jax.tree.map(lambda x: x + 0.5, dep.active.params)
+    ckpt = str(tmp_path / "cand")
+    save_checkpoint(ckpt, candidate, step=3)
+    victim = sorted(
+        p for p in (Path(ckpt) / "3").rglob("*") if p.is_file()
+    )[0]
+    blob = bytearray(victim.read_bytes())
+    blob[0] ^= 0xFF
+    victim.write_bytes(bytes(blob))
+
+    obs = _obs(2, seed=7)
+    reference = _bytes(dep.active.decide_batch(obs))
+    with pytest.raises(CheckpointIntegrityError):
+        dep.promote(ckpt)
+    assert dep.generation == 0 and not dep.rollback_armed
+    assert _bytes(dep.active.decide_batch(obs)) == reference
+    mb.close()
+
+
+def test_parity_probe_rejects_nonfinite_candidate(tmp_path):
+    pol, dep, mb = _deploy_pair()
+    poisoned = jax.tree.map(
+        lambda x: np.full_like(np.asarray(x), np.nan), dep.active.params
+    )
+    ckpt = str(tmp_path / "cand")
+    save_checkpoint(ckpt, poisoned, step=1)
+    obs = _obs(2, seed=8)
+    reference = _bytes(dep.active.decide_batch(obs))
+    with pytest.raises(ParityProbeError, match="non-finite"):
+        dep.promote(ckpt)
+    # routing untouched: the active engine still serves the old policy
+    assert dep.generation == 0
+    assert _bytes(dep.active.decide_batch(obs)) == reference
+    assert _bytes(mb.submit(obs[0]).result(timeout=30)) == _bytes(
+        dep.active.decide_batch(obs[:1])
+    )
+    mb.close()
+
+
+def test_deployer_ledgers_and_counts_every_transition(tmp_path):
+    from gymfx_tpu.telemetry import MetricsRegistry
+    from gymfx_tpu.telemetry.ledger import (
+        RunLedger,
+        read_ledger,
+        validate_ledger,
+    )
+
+    registry = MetricsRegistry()
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    ledger = RunLedger(ledger_path, config={"seed": 5})
+    pol, dep, mb = _deploy_pair(ledger=ledger, registry=registry)
+    candidate = jax.tree.map(lambda x: x - 0.125, dep.active.params)
+    ckpt = str(tmp_path / "cand")
+    save_checkpoint(ckpt, candidate, step=2)
+
+    dep.promote(ckpt)
+    dep.demote("regression")
+    mb.close()
+    ledger.close()
+
+    assert validate_ledger(ledger_path) == []
+    kinds = [r["kind"] for r in read_ledger(ledger_path)]
+    assert kinds == [
+        "run_start", "policy_promote", "policy_demote", "policy_rollback",
+        "run_end",
+    ]
+    rows = {r["kind"]: r for r in read_ledger(ledger_path)}
+    assert rows["policy_promote"]["generation"] == 1
+    assert rows["policy_promote"]["digest"]
+    assert rows["policy_demote"]["reason"] == "regression"
+    assert rows["policy_rollback"]["verified"] is True
+
+    swaps = registry.counter(
+        "gymfx_policy_swaps_total", "", labels=("kind",)
+    )
+    assert swaps.value(kind="promote") == 1.0
+    assert swaps.value(kind="demote") == 1.0
+    assert swaps.value(kind="rollback") == 1.0
+    gen = registry.gauge("gymfx_policy_generation", "")
+    assert gen.value() == 0.0  # rolled back to the boot policy
+
+
+# ----------------------------------------------------------------------
+# the continuous-learning controller
+
+
+def test_controller_gate_failures_become_curriculum_then_promote(tmp_path):
+    from gymfx_tpu.deploy.controller import ContinuousLearningController
+
+    pol, dep, mb = _deploy_pair()
+    train_cfgs = []
+
+    def train_fn(cfg):
+        train_cfgs.append(dict(cfg))
+        params = jax.tree.map(
+            lambda x: x + 0.1 * (len(train_cfgs)), dep.active.params
+        )
+        save_checkpoint(cfg["checkpoint_dir"], params, step=1)
+        return {"checkpoint_dir": cfg["checkpoint_dir"]}
+
+    verdicts = iter([
+        {"passed": False, "scenarios": {
+            "flash_crash": {"passed": False},
+            "regime_mix": {"passed": True},
+        }},
+        {"passed": True, "scenarios": {"flash_crash": {"passed": True}}},
+    ])
+    ctl = ContinuousLearningController(
+        {"seed": 0}, dep,
+        train_fn=train_fn, gate_fn=lambda cfg, ckpt: next(verdicts),
+    )
+
+    r0 = ctl.run_cycle(0, str(tmp_path))
+    assert not r0.gate_passed and not r0.promoted
+    assert r0.failed_presets == ("flash_crash",)
+    assert ctl.curriculum == ("flash_crash",)
+    assert dep.generation == 0  # a failed gate never touches routing
+
+    r1 = ctl.run_cycle(1, str(tmp_path))
+    # the failing preset became cycle 1's training curriculum
+    assert train_cfgs[1]["feed"] == "scengen"
+    assert train_cfgs[1]["scengen_preset"] == "flash_crash"
+    assert r1.gate_passed and r1.promoted and not r1.demoted
+    assert r1.generation == 1 and r1.swap_latency_s is not None
+    assert ctl.curriculum == ()  # cleared by the clean gate
+    mb.close()
+
+
+def test_controller_regression_demotes_with_verified_rollback(tmp_path):
+    from gymfx_tpu.deploy.controller import ContinuousLearningController
+
+    pol, dep, mb = _deploy_pair()
+
+    def train_fn(cfg):
+        params = jax.tree.map(lambda x: x + 0.3, dep.active.params)
+        save_checkpoint(cfg["checkpoint_dir"], params, step=1)
+        return {"checkpoint_dir": cfg["checkpoint_dir"]}
+
+    ctl = ContinuousLearningController(
+        {"seed": 0}, dep,
+        train_fn=train_fn,
+        gate_fn=lambda cfg, ckpt: {
+            "passed": True, "scenarios": {"regime_mix": {"passed": True}},
+        },
+        regress_fn=lambda dep_, **kw: True,
+    )
+    r = ctl.run_cycle(0, str(tmp_path))
+    assert r.promoted and r.demoted
+    assert r.rollback_verified is True
+    assert r.generation == 0  # back on the boot policy
+    mb.close()

@@ -275,6 +275,73 @@ def test_policy_validators_reject_unknown_names():
 
 
 # ----------------------------------------------------------------------
+# pause()/resume(): the micro-batch boundary hook the blue/green
+# deployer flips engines inside (gymfx_tpu/serve/deploy.py)
+
+
+def test_pause_parks_worker_without_queue_loss_then_resume_flips_engine():
+    eng, mb, f0 = _blocked_batcher()
+    rows = _rows(2, seed=11)
+    f1, f2 = mb.submit(rows[0]), mb.submit(rows[1])
+
+    parked = {"ok": None}
+    pauser = threading.Thread(
+        target=lambda: parked.update(ok=mb.pause(timeout=30))
+    )
+    pauser.start()
+    time.sleep(0.02)
+    assert parked["ok"] is None  # pause waits for the in-flight dispatch
+    eng.gate.set()               # dispatch completes -> worker parks
+    pauser.join(timeout=30)
+    assert parked["ok"] is True
+    assert isinstance(f0.result(timeout=30), Decision)
+
+    h = mb.health()
+    assert h["paused"] is True
+    assert h["queue_depth"] == 2       # queued requests stay QUEUED
+    assert not f1.done() and not f2.done()
+    f3 = mb.submit(_rows(1, seed=12)[0])  # admissions stay open too
+
+    eng2 = FakeEngine()                # the deployer's flip, verbatim
+    mb.engine = eng2
+    mb.resume()
+    for f in (f1, f2, f3):
+        assert isinstance(f.result(timeout=30), Decision)
+    assert eng2.dispatch_count > 0     # served by the NEW engine
+    assert eng.dispatch_count == 1     # old engine saw only the pre-pause batch
+    assert mb.health()["paused"] is False
+    mb.close()
+
+
+def test_pause_timeout_rolls_back_and_queue_keeps_moving():
+    eng, mb, f0 = _blocked_batcher()   # in-flight dispatch held at the gate
+    t0 = time.perf_counter()
+    assert mb.pause(timeout=0.05) is False  # bounded: cannot park in time
+    assert time.perf_counter() - t0 < 5.0
+    assert mb.health()["paused"] is False   # rolled back, not wedged
+    eng.gate.set()
+    assert isinstance(f0.result(timeout=30), Decision)
+    # the queue keeps moving after the failed pause
+    assert isinstance(mb.submit(_rows(1, seed=13)[0]).result(timeout=30),
+                      Decision)
+    mb.close()
+
+
+def test_pause_is_idempotent_and_closed_batcher_raises():
+    eng = FakeEngine()
+    mb = MicroBatcher(eng, max_batch_wait_ms=0.0)
+    assert mb.pause(timeout=30) is True   # idle worker parks immediately
+    assert mb.pause(timeout=30) is True   # idempotent
+    mb.resume()
+    mb.resume()                            # idempotent no-op
+    assert isinstance(mb.submit(_rows(1, seed=14)[0]).result(timeout=30),
+                      Decision)
+    mb.close()
+    with pytest.raises(BatcherClosedError):
+        mb.pause(timeout=1)
+
+
+# ----------------------------------------------------------------------
 # serving chaos harness: FlakyEngine + the serve/burst profile grammar
 
 

@@ -1,0 +1,165 @@
+"""Soak harness contract (tools/soak.py + tools/soak_report_schema.json).
+
+Two layers: the schema validator must catch every class of report
+drift (missing keys, retyped fields, non-finite numbers), and an
+in-process quick soak with stub train/gate functions must hold the
+acceptance bar — zero dropped decisions, zero late compiles, and a
+bitwise-verified rollback — under the default fault grammar.
+"""
+import importlib.util
+import sys
+from pathlib import Path
+
+import jax
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_soak():
+    spec = importlib.util.spec_from_file_location(
+        "gymfx_soak", REPO / "tools" / "soak.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("gymfx_soak", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+soak = _load_soak()
+
+
+def _good_report():
+    schema = soak.load_schema()
+    report = {}
+    for key in schema["required"]:
+        if key in schema["integer"]:
+            report[key] = 0
+        elif key in schema["numeric"]:
+            report[key] = 0.0
+        elif key in schema["boolean"]:
+            report[key] = True
+        else:
+            report[key] = "x"
+    report["kind"] = "soak_report"
+    report["schema_version"] = 1
+    return report
+
+
+# ----------------------------------------------------------------------
+# schema drift
+
+
+def test_validator_accepts_conforming_report():
+    assert soak.validate_soak_report(_good_report()) == []
+
+
+def test_validator_flags_every_drift_class():
+    base = _good_report()
+
+    wrong_kind = dict(base, kind="bench_report")
+    assert any("kind" in p for p in soak.validate_soak_report(wrong_kind))
+
+    for key in ("dropped_decisions", "late_compiles", "rollback_verified",
+                "passed", "swap_latency_p99_ms", "fault_profile"):
+        missing = dict(base)
+        del missing[key]
+        assert any(
+            key in p for p in soak.validate_soak_report(missing)
+        ), f"missing {key!r} not flagged"
+
+    retyped = dict(base, dropped_decisions=0.0)  # float where int pinned
+    assert any(
+        "dropped_decisions" in p for p in soak.validate_soak_report(retyped)
+    )
+    retyped = dict(base, dropped_decisions=True)  # bool is not an int here
+    assert any(
+        "dropped_decisions" in p for p in soak.validate_soak_report(retyped)
+    )
+    retyped = dict(base, rollback_verified=1)  # int is not a bool
+    assert any(
+        "rollback_verified" in p for p in soak.validate_soak_report(retyped)
+    )
+    nonfinite = dict(base, swap_latency_p99_ms=float("nan"))
+    assert any(
+        "swap_latency_p99_ms" in p
+        for p in soak.validate_soak_report(nonfinite)
+    )
+
+    assert soak.validate_soak_report(["not", "a", "dict"])
+
+
+def test_schema_file_pins_the_acceptance_keys():
+    schema = soak.load_schema()
+    required = set(schema["required"])
+    # the CI leg's three acceptance criteria must stay pinned
+    assert {"dropped_decisions", "late_compiles", "rollback_verified",
+            "passed", "completed_cycles", "fault_profile"} <= required
+    # every typed key is also required (no optional typed fields)
+    for group in ("integer", "numeric", "boolean"):
+        assert set(schema[group]) <= required
+
+
+# ----------------------------------------------------------------------
+# in-process quick soak
+
+
+def test_quick_soak_holds_the_acceptance_bar(tmp_path):
+    from gymfx_tpu.config.defaults import DEFAULT_VALUES
+    from gymfx_tpu.serve.engine import engine_from_config
+    from gymfx_tpu.train.checkpoint import save_checkpoint
+
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update(soak.QUICK_CONFIG)
+    cfg["num_envs"] = 8
+    cfg["train_total_steps"] = 8 * int(cfg["ppo_horizon"])
+
+    template = engine_from_config(
+        {**cfg, "checkpoint_dir": None}, warmup=False
+    ).engine.params
+    calls = []
+
+    def train_fn(c):
+        calls.append(dict(c))
+        params = jax.tree.map(
+            lambda x: x + 0.05 * len(calls), template
+        )
+        save_checkpoint(c["checkpoint_dir"], params, step=1)
+        return {"checkpoint_dir": c["checkpoint_dir"]}
+
+    verdicts = iter([
+        {"passed": False,
+         "scenarios": {"flash_crash": {"passed": False}}},
+        {"passed": True, "scenarios": {"regime_mix": {"passed": True}}},
+        {"passed": True, "scenarios": {"regime_mix": {"passed": True}}},
+    ])
+
+    report = soak.run_soak(
+        cfg,
+        cycles=3,
+        fault_profile=soak.DEFAULT_FAULT_PROFILE,
+        workdir=str(tmp_path),
+        train_fn=train_fn,
+        gate_fn=lambda c, ckpt: next(verdicts),
+        out=str(tmp_path / "soak_report.json"),
+    )
+
+    assert soak.validate_soak_report(report) == []
+    assert report["passed"] is True
+    assert report["completed_cycles"] == 3
+    assert report["dropped_decisions"] == 0
+    assert report["late_compiles"] == 0
+    assert report["rollback_verified"] is True
+    assert report["promotions"] == 2
+    assert report["gate_failures"] == 1
+    assert report["ledger_valid"] is True
+    # every submitted decision resolved: with a value or a typed error
+    assert (report["resolved_decisions"]
+            == report["submitted_decisions"])
+    # the written artifact round-trips through the validator too
+    import json
+
+    on_disk = json.loads((tmp_path / "soak_report.json").read_text())
+    assert soak.validate_soak_report(on_disk) == []
+    # gate failure on cycle 0 fed cycle 1's curriculum
+    assert calls[1]["feed"] == "scengen"
+    assert calls[1]["scengen_preset"] == "flash_crash"

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Digest-audit a checkpoint tree without restoring it.
+
+Recomputes every step directory's sha256 against its
+``digest_<step>.json`` sidecar (train/checkpoint.py) — no orbax
+restore, no tensor materialization, so an operator can audit a
+multi-GB tree from any box that can read the files:
+
+    python tools/checkpoint_audit.py /path/to/ckpts
+    python tools/checkpoint_audit.py /path/to/ckpts --json
+
+Exit status: 0 when every step verifies (legacy steps without a
+sidecar are accepted, flagged ``legacy``), 1 when any step fails, 2 on
+an empty/missing tree.  The deployer runs the same check
+(``verify_checkpoint``) before every promote.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("directory", help="checkpoint tree to audit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the audit rows as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    from gymfx_tpu.train.checkpoint import audit_checkpoint_tree
+
+    rows = audit_checkpoint_tree(args.directory)
+    if not rows:
+        print(f"no checkpoint steps under {args.directory}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+    else:
+        print(f"{'step':>10}  {'status':<8}  {'files':>5}  digest")
+        for row in rows:
+            status = (
+                "legacy" if row["legacy"]
+                else ("ok" if row["verified"] else "FAILED")
+            )
+            print(
+                f"{row['step']:>10}  {status:<8}  "
+                f"{row['files'] if row['files'] is not None else '-':>5}  "
+                f"{row['digest'] or '-'}"
+            )
+    failed = [row["step"] for row in rows if not row["verified"]]
+    if failed:
+        print(
+            f"checkpoint audit FAILED: steps {failed} do not match their "
+            f"recorded digests", file=sys.stderr,
+        )
+        return 1
+    print(
+        f"checkpoint audit OK ({len(rows)} steps, "
+        f"{sum(1 for r in rows if r['legacy'])} legacy)", file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,7 +25,11 @@
 # profiler-armed training run must land a capture bundle whose report
 # validates against profile_report_schema.json, reconciles trace
 # attribution with the measured phase split, and whose --compare gate
-# fails a synthetic kernel regression), then a telemetry smoke
+# fails a synthetic kernel regression), a soak-quick leg (two
+# retrain->gate->swap->serve cycles under the fault grammar: schema-
+# valid soak report, zero dropped decisions, zero late compiles,
+# bitwise-verified rollback — docs/resilience.md), then a telemetry
+# smoke
 # (ephemeral /metrics endpoint, one scrape, assert non-empty —
 # docs/observability.md) and a per-run summary row appended to
 # PROGRESS.jsonl through the JSONL sink.
@@ -207,6 +211,48 @@ with tempfile.TemporaryDirectory() as d:
 EOF
 echo "performance observatory smoke: rc=$profile_rc"
 
+# soak-quick leg: a two-cycle retrain->gate->swap->serve loop on CPU
+# under the default fault grammar must emit a schema-valid soak report
+# with zero dropped decisions, zero late compiles, and a bitwise-
+# verified rollback (docs/resilience.md, "Continuous-learning loop")
+soak_rc=0
+env JAX_PLATFORMS=cpu python - <<'EOF' || soak_rc=$?
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, "tools")
+from soak import validate_soak_report  # noqa: E402
+
+with tempfile.TemporaryDirectory() as d:
+    out = Path(d) / "soak_report.json"
+    run = subprocess.run(
+        [sys.executable, "tools/soak.py", "--quick", "--cycles", "2",
+         "--envs", "64", "--workdir", d, "--out", str(out)],
+        capture_output=True, text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if run.returncode != 0 or not out.exists():
+        print("soak CLI failed:", run.stdout[-2000:], run.stderr[-2000:])
+        sys.exit(run.returncode or 1)
+    report = json.loads(out.read_text(encoding="utf-8"))
+    problems = validate_soak_report(report)
+    if problems:
+        print("SOAK REPORT SCHEMA VIOLATIONS:", *problems, sep="\n  ")
+        sys.exit(1)
+    assert report["passed"] is True, report
+    assert report["dropped_decisions"] == 0, report
+    assert report["late_compiles"] == 0, report
+    assert report["rollback_verified"] is True, report
+    print(f"soak-quick OK ({report['completed_cycles']} cycles, "
+          f"{report['submitted_decisions']} decisions, "
+          f"{report['fault_errors']} typed fault errors, "
+          f"swap p99 {report['swap_latency_p99_ms']:.2f} ms)")
+EOF
+echo "soak-quick (2 cycles, fault grammar): rc=$soak_rc"
+
 # telemetry smoke + PROGRESS row (registry/http/sink are jax-free:
 # this is sub-second and runs even when the suite failed, so the row
 # records the failure too)
@@ -263,5 +309,8 @@ if [ "$ledger_rc" -ne 0 ]; then
 fi
 if [ "$profile_rc" -ne 0 ]; then
     exit "$profile_rc"
+fi
+if [ "$soak_rc" -ne 0 ]; then
+    exit "$soak_rc"
 fi
 exit "$smoke_rc"

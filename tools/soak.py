@@ -1,0 +1,365 @@
+#!/usr/bin/env python3
+"""N-cycle continuous-learning soak: retrain -> gate -> swap -> serve
+under the ``fault_profile`` burst grammar (docs/resilience.md,
+"Continuous-learning loop").
+
+Each cycle the controller trains a candidate checkpoint, gates it on
+the scenario suite, and hot-swaps it into the blue/green serving pair;
+between cycles the harness fires burst rounds of decision requests
+(``burst=NxK`` from the profile) through the micro-batcher with the
+active engine wrapped in a FlakyEngine consuming the profile's
+``serve=`` fault plan.  After the last cycle the live policy is
+force-demoted so the run always exercises a bitwise-verified rollback.
+
+The run emits a schema-pinned ``soak_report.json``
+(tools/soak_report_schema.json) whose contract the CI soak-quick leg
+pins: ``dropped_decisions == 0`` (every submitted request resolved —
+with a decision or exactly one typed error), ``late_compiles == 0``
+(the ladder never recompiled after boot, across every swap), and
+``rollback_verified == true`` (post-rollback decisions bitwise equal
+to pre-promotion on the pinned obs replay).
+
+    python tools/soak.py --quick --cycles 2 --envs 64 \
+        --fault_profile 'serve=exc+ok+slow:5;burst=8x3;seed=0'
+    python tools/soak.py --cycles 5 --out soak_report.json
+
+``validate_soak_report`` is imported by tests/test_soak.py and the
+tools/run_tests.sh leg, keeping the schema and this emitter from
+drifting apart silently.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+from concurrent.futures import TimeoutError as FuturesTimeout
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SCHEMA_PATH = Path(__file__).resolve().parent / "soak_report_schema.json"
+
+DEFAULT_FAULT_PROFILE = "serve=exc+ok+slow:5;burst=8x3;seed=0"
+
+# the sub-minute CI shape: tiny policy, one-superstep training cycles,
+# a two-bucket ladder (three warm engine boots stay cheap), quick gate
+QUICK_CONFIG = {
+    "input_file": "tests/data/eurusd_uptrend.csv",
+    "window_size": 8,
+    "num_envs": 64,
+    "ppo_horizon": 16,
+    "ppo_epochs": 1,
+    "ppo_minibatches": 1,
+    "policy_kwargs": {"hidden": [16, 16]},
+    "train_total_steps": 64 * 16,
+    "seed": 1,
+    "serve_buckets": [1, 8],
+    "serve_max_batch_wait_ms": 1.0,
+    "quiet_mode": True,
+}
+
+
+def load_schema() -> Dict[str, Any]:
+    with open(SCHEMA_PATH, encoding="utf-8") as fh:
+        schema = json.load(fh)
+    schema.pop("_comment", None)
+    return schema
+
+
+def validate_soak_report(report: Dict[str, Any],
+                         schema: Optional[Dict[str, Any]] = None) -> List[str]:
+    """Return a list of contract violations (empty = report conforms)."""
+    if schema is None:
+        schema = load_schema()
+    if not isinstance(report, dict):
+        return [f"report is not a JSON object: {type(report).__name__}"]
+    problems: List[str] = []
+    if report.get("kind") != schema["kind"]:
+        problems.append(
+            f"kind must be {schema['kind']!r}, got {report.get('kind')!r}"
+        )
+    for key in schema["required"]:
+        if key not in report:
+            problems.append(f"missing required key {key!r}")
+    for key in schema["integer"]:
+        if key in report and not (
+            isinstance(report[key], int) and not isinstance(report[key], bool)
+        ):
+            problems.append(
+                f"key {key!r} must be an integer, got {report[key]!r}"
+            )
+    for key in schema["numeric"]:
+        if key in report and not (
+            isinstance(report[key], (int, float))
+            and not isinstance(report[key], bool)
+            and math.isfinite(float(report[key]))
+        ):
+            problems.append(
+                f"key {key!r} must be a finite number, got {report[key]!r}"
+            )
+    for key in schema["boolean"]:
+        if key in report and not isinstance(report[key], bool):
+            problems.append(
+                f"key {key!r} must be a boolean, got {report[key]!r}"
+            )
+    return problems
+
+
+def _quick_gate(config: Dict[str, Any], checkpoint_dir: str,
+                ) -> Dict[str, Any]:
+    """Narrowed in-process gate for soak cycles: one preset, short tape
+    — the full quick matrix already runs as its own CI leg, and the
+    second cycle reuses the first cycle's jit cache."""
+    from gymfx_tpu.deploy.controller import load_scenario_gate
+
+    gate = load_scenario_gate()
+    return gate.run_gate(
+        presets=("regime_mix",), quick=True, serving_ticks=4,
+        seed=int(config.get("seed", 0) or 0),
+    )
+
+
+def _serve_burst(batcher: Any, rng: Any, size: int, *,
+                 timeout_s: float = 60.0) -> Dict[str, int]:
+    """Fire one burst of ``size`` concurrent submits and account for
+    every future: resolved-with-decision, resolved-with-typed-error, or
+    (never, by contract) dropped."""
+    engine = batcher.engine
+    obs = rng.standard_normal((size, *engine.obs_shape)).astype(
+        engine.obs_dtype
+    )
+    futures = []
+    for row in obs:
+        try:
+            futures.append(batcher.submit(row))
+        except Exception:
+            # admission-control rejection (shed) is a typed RESOLUTION
+            # of the request, not a drop
+            futures.append(None)
+    decided = errored = dropped = 0
+    for fut in futures:
+        if fut is None:
+            errored += 1
+            continue
+        try:
+            fut.result(timeout=timeout_s)
+            decided += 1
+        except FuturesTimeout:
+            dropped += 1  # never resolved — the contract violation
+        except Exception:
+            errored += 1  # typed resolution (fault, shed, deadline, ...)
+    return {
+        "submitted": size,
+        "decided": decided,
+        "errored": errored,
+        "dropped": dropped,
+    }
+
+
+def run_soak(
+    config: Dict[str, Any],
+    *,
+    cycles: int = 3,
+    fault_profile: str = DEFAULT_FAULT_PROFILE,
+    workdir: str,
+    train_fn: Optional[Callable[[Dict[str, Any]], Any]] = None,
+    gate_fn: Optional[Callable[..., Dict[str, Any]]] = None,
+    out: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Run the soak and return (and optionally write) the report.
+
+    ``train_fn`` / ``gate_fn`` inject sub-second stand-ins for tests;
+    the defaults are the real trainer and the narrowed one-preset gate.
+    """
+    import numpy as np
+
+    from gymfx_tpu.deploy.controller import controller_from_config
+    from gymfx_tpu.resilience.faults import (
+        flaky_engine_from_profile,
+        parse_fault_profile,
+    )
+    from gymfx_tpu.telemetry import MetricsRegistry
+    from gymfx_tpu.telemetry.compile_watch import CompileWatch
+    from gymfx_tpu.telemetry.ledger import RunLedger, validate_ledger
+
+    t_start = time.perf_counter()
+    workdir_p = Path(workdir)
+    workdir_p.mkdir(parents=True, exist_ok=True)
+    profile = parse_fault_profile(fault_profile)
+    burst = profile.get("burst") or {"size": 8, "rounds": 2}
+
+    cfg = dict(config)
+    cfg.pop("checkpoint_dir", None)  # per-cycle dirs come from the loop
+    cfg["fault_profile"] = fault_profile  # training feed sees the grammar
+
+    registry = MetricsRegistry()
+    ledger_path = str(workdir_p / "soak_ledger.jsonl")
+    ledger = RunLedger(ledger_path, config=cfg)
+    watch = CompileWatch(registry, ledger=ledger, name="soak")
+
+    controller, db = controller_from_config(
+        cfg,
+        ledger=ledger,
+        registry=registry,
+        # re-wrapped at every flip: the fault plan follows the ACTIVE
+        # engine and restarts per generation, keeping pressure constant
+        wrap_engine=lambda e: flaky_engine_from_profile(e, profile),
+        train_fn=train_fn,
+        gate_fn=gate_fn if gate_fn is not None else _quick_gate,
+    )
+    deployer, batcher = db.deployer, db.batcher
+    watch.watch_engine(deployer.active, name="serve_blue")
+    watch.watch_engine(deployer.standby, name="serve_green")
+
+    rng = np.random.default_rng(int(profile.get("seed", 0)))
+    submitted = decided = errored = dropped = 0
+    completed = 0
+    try:
+        for i in range(int(cycles)):
+            controller.run_cycle(i, str(workdir_p))
+            for _ in range(int(burst["rounds"])):
+                counts = _serve_burst(batcher, rng, int(burst["size"]))
+                submitted += counts["submitted"]
+                decided += counts["decided"]
+                errored += counts["errored"]
+                dropped += counts["dropped"]
+            completed += 1
+        # the forced demote: every soak run must PROVE rollback works,
+        # not just that promotes do
+        rollback_verified = final_demoted = False
+        if deployer.rollback_armed:
+            final_demoted = True
+            rollback_verified = bool(
+                deployer.demote("soak_forced_rollback").verified
+            )
+    finally:
+        batcher.close()
+        ledger.close()
+
+    results = controller.results
+    swaps_ms = [
+        r.swap_latency_s * 1e3 for r in results
+        if r.swap_latency_s is not None
+    ]
+    late = int(deployer.active.late_compiles) + int(
+        deployer.standby.late_compiles
+    )
+    ledger_problems = validate_ledger(ledger_path)
+    from gymfx_tpu.telemetry.ledger import read_ledger
+
+    n_rows = len(read_ledger(ledger_path))
+    report = {
+        "kind": "soak_report",
+        "schema_version": 1,
+        "cycles": int(cycles),
+        "completed_cycles": int(completed),
+        "fault_profile": str(fault_profile),
+        "num_envs": int(cfg.get("num_envs", 0) or 0),
+        "swap_latency_p99_ms": (
+            float(np.percentile(np.asarray(swaps_ms), 99.0))
+            if swaps_ms else 0.0
+        ),
+        "submitted_decisions": int(submitted),
+        "resolved_decisions": int(decided + errored),
+        "dropped_decisions": int(dropped),
+        "fault_errors": int(errored),
+        "late_compiles": late,
+        "promotions": int(sum(1 for r in results if r.promoted)),
+        "demotions": int(
+            sum(1 for r in results if r.demoted) + (1 if final_demoted else 0)
+        ),
+        "gate_failures": int(sum(1 for r in results if not r.gate_passed)),
+        "rollback_verified": bool(rollback_verified),
+        "final_generation": int(deployer.generation),
+        "ledger_rows": int(n_rows),
+        "ledger_valid": not ledger_problems,
+        "wall_s": float(time.perf_counter() - t_start),
+        "passed": bool(
+            completed == int(cycles)
+            and dropped == 0
+            and late == 0
+            and rollback_verified
+            and not ledger_problems
+        ),
+    }
+    if out:
+        Path(out).write_text(
+            json.dumps(report, indent=2, sort_keys=True) + "\n",
+            encoding="utf-8",
+        )
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cycles", type=int, default=3,
+                    help="retrain->gate->swap cycles to run")
+    ap.add_argument("--envs", type=int, default=None,
+                    help="override num_envs for the training cycles")
+    ap.add_argument(
+        "--fault_profile", type=str, default=DEFAULT_FAULT_PROFILE,
+        help="fault grammar (resilience/faults.py); burst=NxK shapes "
+             "the serve bursts between cycles",
+    )
+    ap.add_argument("--quick", action="store_true",
+                    help=f"CI shape: {QUICK_CONFIG}")
+    ap.add_argument("--workdir", type=str, default=None,
+                    help="checkpoint/ledger scratch dir (default: a "
+                         "fresh temp dir)")
+    ap.add_argument("--out", type=str, default="soak_report.json",
+                    help="report path (always printed to stdout)")
+    args = ap.parse_args(argv)
+
+    from gymfx_tpu.config.defaults import DEFAULT_VALUES
+
+    config = dict(DEFAULT_VALUES)
+    if args.quick:
+        config.update(QUICK_CONFIG)
+    if args.envs:
+        config["num_envs"] = int(args.envs)
+        if args.quick:
+            config["train_total_steps"] = (
+                int(args.envs) * int(config["ppo_horizon"])
+            )
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        workdir = args.workdir or tmp
+        report = run_soak(
+            config,
+            cycles=args.cycles,
+            fault_profile=args.fault_profile,
+            workdir=workdir,
+            out=args.out,
+        )
+    problems = validate_soak_report(report)
+    if problems:  # emitter bug — fail loudly, never ship a bad report
+        for p in problems:
+            print(f"SOAK REPORT SCHEMA VIOLATION: {p}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if not report["passed"]:
+        print(
+            f"soak FAILED: dropped={report['dropped_decisions']} "
+            f"late_compiles={report['late_compiles']} "
+            f"rollback_verified={report['rollback_verified']} "
+            f"cycles={report['completed_cycles']}/{report['cycles']}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"soak OK ({report['completed_cycles']} cycles, "
+        f"{report['submitted_decisions']} decisions, "
+        f"swap p99 {report['swap_latency_p99_ms']:.2f} ms)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
