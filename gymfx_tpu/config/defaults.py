@@ -232,6 +232,33 @@ DEFAULT_VALUES = {
     # controller's train->gate->swap loop (full matrix when False)
     "deploy_gate_quick": True,
 
+    # ---- decision fleet (docs/serving.md, "Decision fleet") — only
+    # read when serve_fleet_replicas >= 1; with it at 0 the serving path
+    # is the single engine + micro-batcher pair, bitwise identical to
+    # the pre-fleet code.
+    # active replicas behind the fleet front-end; 0 = fleet off
+    "serve_fleet_replicas": 0,
+    # warm standby engines booted alongside (promoted on failover)
+    "serve_fleet_standbys": 1,
+    # fleet-wide admission gate: total queued requests across replicas
+    # before submits shed with reason "fleet_queue_full"; null = no gate
+    # (per-replica serve_max_queue still applies)
+    "serve_fleet_max_queue": None,
+    # supervisor probe cadence / per-probe timeout / pinned-obs rows
+    "serve_fleet_probe_interval_s": 0.25,
+    "serve_fleet_probe_timeout_s": 2.0,
+    "serve_fleet_probe_rows": 2,
+    # probe latency above this marks a replica degraded (new sessions
+    # avoid it); consecutive probe FAILURES at/above dead_after mark it
+    # dead and trigger failover
+    "serve_fleet_degraded_latency_ms": 250.0,
+    "serve_fleet_dead_after": 1,
+    # replica-death re-routes per request before its future fails with
+    # the underlying error
+    "serve_fleet_retry_limit": 2,
+    # SessionStateStore capacity: LRU-evicted beyond this many sessions
+    "serve_fleet_max_sessions": 1000000,
+
     # ---- telemetry (gymfx_tpu/telemetry/, docs/observability.md) ----
     # ALL off by default: with every telemetry_* knob unset,
     # telemetry_from_config returns None and the train/serve hot paths

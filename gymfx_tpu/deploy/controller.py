@@ -222,7 +222,35 @@ def controller_from_config(
 ):
     """One-call construction of the full loop: blue/green serving stack
     (engines + batcher + deployer) plus the controller driving it.
-    Returns ``(controller, deploy_bundle)``."""
+    Returns ``(controller, deploy_bundle)``.
+
+    With ``serve_fleet_replicas`` >= 1 the serving stack is a
+    :class:`~gymfx_tpu.serve.fleet.DecisionFleet` instead of one
+    blue/green pair: the controller drives the same
+    promote/demote/generation surface, but a promote swaps weights into
+    EVERY replica and standby (docs/serving.md, "Decision fleet").  The
+    fleet builds per-replica instruments from ``registry`` itself, so
+    ``instruments`` is only used on the single-replica path."""
+    fleet_replicas = int(config.get("serve_fleet_replicas", 0) or 0)
+    if fleet_replicas >= 1:
+        from gymfx_tpu.serve.fleet import fleet_from_config
+
+        fb = fleet_from_config(
+            config,
+            ledger=ledger,
+            registry=registry,
+            wrap_engine=wrap_engine,
+        )
+        controller = ContinuousLearningController(
+            config,
+            fb.fleet,
+            train_fn=train_fn,
+            gate_fn=gate_fn,
+            regress_fn=regress_fn,
+            ledger=ledger,
+        )
+        return controller, fb
+
     from gymfx_tpu.serve.deploy import bluegreen_from_config
 
     db = bluegreen_from_config(

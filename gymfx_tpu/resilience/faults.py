@@ -37,6 +37,14 @@ Profile grammar — semicolon-separated ``key=value`` clauses::
   burst                 ``NxK`` — the burst-arrival shape for overload
                         scenarios: K rounds of N simultaneous requests
                         (consumed by bench_infer.py's chaos phase)
+  fleet                 ``+``-joined fleet fault events of the form
+                        ``<action>:<replica>@<decision>[:<ms>]`` —
+                        ``kill:1@8`` kills replica 1 at global decision
+                        index 8, ``stall:0@4:250`` wedges replica 0's
+                        next dispatch for 250 ms at decision 4,
+                        ``flap:2@6`` makes replica 2 throw transient
+                        dispatch errors at decision 6 then recover
+                        (consumed by tools/fleet_chaos.py)
   preempt_at            iteration index after which the trainer raises
                         SimulatedPreemptionError (checkpoint drill)
   scengen               a scengen preset name (``scengen=flash_crash``):
@@ -80,6 +88,15 @@ SERVE_FAULT_TOKENS = (
 )
 
 
+FLEET_FAULT_ACTIONS = (
+    "kill",     # hard-fail the replica: batcher killed, standby promoted
+    "stall",    # one wedged dispatch of <ms> (supervisor sees a slow/dead
+                # probe; requests re-route)
+    "flap",     # a short burst of dispatch exceptions, then recovery —
+                # the transient-fault case retries must absorb
+)
+
+
 class InjectedDispatchError(RuntimeError):
     """Injected engine-dispatch failure (the serving chaos harness's
     stand-in for an XLA runtime error / device loss mid-dispatch)."""
@@ -120,8 +137,40 @@ class FlakyEngine:
         self.faults_injected = 0
         self.history: List[str] = []
 
+    # attributes that belong to the WRAPPER; everything else reads from
+    # and writes through to the wrapped engine, so deployer/fleet wiring
+    # (``engine.on_compile = cb``, ``engine.params = ...``) lands on the
+    # real engine even when chaos is interposed
+    _OWN_ATTRS = frozenset(
+        {
+            "_inner",
+            "_plan",
+            "_rate",
+            "_rate_tokens",
+            "_rng",
+            "_sleep",
+            "dispatch_calls",
+            "faults_injected",
+            "history",
+        }
+    )
+
     def __getattr__(self, name: str) -> Any:
         return getattr(self._inner, name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in self._OWN_ATTRS or "_inner" not in self.__dict__:
+            object.__setattr__(self, name, value)
+        elif hasattr(self._inner, name):
+            setattr(self._inner, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def push_faults(self, *tokens: str) -> None:
+        """Append fault tokens to the scripted plan mid-run — how the
+        fleet-chaos harness turns a parsed ``fleet=`` stall/flap event
+        into this replica's next dispatches."""
+        self._plan.extend(str(t) for t in tokens)
 
     def _next_token(self) -> str:
         if self._plan:
@@ -334,6 +383,39 @@ def nonfinite_report(data: Any) -> Dict[str, int]:
     return out
 
 
+def _parse_fleet_token(tok: str) -> Dict[str, Any]:
+    """Parse one fleet fault event ``<action>:<replica>@<decision>[:<ms>]``
+    (``ms`` only for ``stall``, default 250)."""
+    action, sep, rest = tok.partition(":")
+    if action not in FLEET_FAULT_ACTIONS or not sep:
+        raise ValueError(
+            f"fleet fault token {tok!r} must start with one of "
+            f"{FLEET_FAULT_ACTIONS} followed by ':<replica>@<decision>'"
+        )
+    replica_s, at_sep, at_s = rest.partition("@")
+    if not at_sep:
+        raise ValueError(
+            f"fleet fault token {tok!r} is missing '@<decision>'"
+        )
+    ms: Optional[float] = None
+    if action == "stall":
+        at_s, _, ms_s = at_s.partition(":")
+        ms = float(ms_s) if ms_s else 250.0
+        if ms <= 0:
+            raise ValueError(f"fleet stall ms must be > 0, got {ms!r}")
+    elif ":" in at_s:
+        raise ValueError(
+            f"fleet fault token {tok!r}: only 'stall' takes a ':<ms>' tail"
+        )
+    replica, at = int(replica_s), int(at_s)
+    if replica < 0 or at < 0:
+        raise ValueError(
+            f"fleet fault token {tok!r}: replica and decision index "
+            "must be >= 0"
+        )
+    return {"action": action, "replica": replica, "at": at, "ms": ms}
+
+
 def _parse_bars(spec: str) -> List[int]:
     spec = spec.strip()
     if "-" in spec:
@@ -350,6 +432,8 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
          "transport_plan": [...], "transport_rate": float,
          "serve_plan": [...], "serve_rate": float,
          "burst": {"size": int, "rounds": int}|None,
+         "fleet": [{"action": str, "replica": int, "at": int,
+                    "ms": float|None}, ...]  (sorted by "at"),
          "preempt_at": int|None, "seed": int}
 
     Empty/None spec parses to an all-inert profile; unknown clause keys
@@ -364,6 +448,7 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
         "serve_plan": [],
         "serve_rate": 0.0,
         "burst": None,
+        "fleet": [],
         "preempt_at": None,
         "scengen": None,
         "seed": 0,
@@ -411,6 +496,10 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
                 raise ValueError(
                     f"burst clause must be NxK with N,K >= 1, got {val!r}"
                 )
+        elif key == "fleet":
+            for tok in [t for t in val.replace(",", "+").split("+") if t]:
+                profile["fleet"].append(_parse_fleet_token(tok))
+            profile["fleet"].sort(key=lambda ev: ev["at"])
         elif key == "preempt_at":
             profile["preempt_at"] = int(val)
         elif key == "scengen":
@@ -425,8 +514,8 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
         else:
             raise ValueError(
                 f"unknown fault_profile key {key!r}; known: nan_bars, "
-                "inf_bars, fields, transport, serve, burst, preempt_at, "
-                "scengen, seed"
+                "inf_bars, fields, transport, serve, burst, fleet, "
+                "preempt_at, scengen, seed"
             )
     return profile
 

@@ -2,26 +2,45 @@
 
 The serving counterpart of the training stack: an AOT-compiled,
 shape-bucketed forward pass (:mod:`engine`), a micro-batching scheduler
-coalescing concurrent requests into one dispatch (:mod:`batcher`), and
-a per-session O(1) featurizer producing observations bit-identical to
-the training env's (:mod:`features`), and blue/green hot-swap
-deployment over the compiled ladder (:mod:`deploy`)."""
+coalescing concurrent requests into one dispatch (:mod:`batcher`), a
+per-session O(1) featurizer producing observations bit-identical to
+the training env's (:mod:`features`), blue/green hot-swap deployment
+over the compiled ladder (:mod:`deploy`), and a fault-tolerant
+N-replica decision fleet with health-probed failover and session-state
+handoff (:mod:`fleet`)."""
 from gymfx_tpu.serve.batcher import (
     MicroBatcher,
     RequestRecord,
     batcher_from_config,
 )
-from gymfx_tpu.serve.config import ServeConfig, serve_config_from
+from gymfx_tpu.serve.config import (
+    FleetConfig,
+    ServeConfig,
+    fleet_config_from,
+    serve_config_from,
+)
 from gymfx_tpu.serve.deploy import (
     BlueGreenDeployer,
     DeployError,
     ParityProbeError,
     bluegreen_from_config,
+    decision_bytes,
+)
+from gymfx_tpu.serve.fleet import (
+    DecisionFleet,
+    FleetBundle,
+    FleetError,
+    ReplicaSupervisor,
+    SessionStateStore,
+    fleet_from_config,
+    params_digest,
 )
 from gymfx_tpu.serve.overload import (
     OVERLOAD_ERRORS,
     BatcherClosedError,
     DeadlineExceeded,
+    DrainWhilePausedError,
+    NoHealthyReplicaError,
     ShedError,
 )
 from gymfx_tpu.serve.engine import (
@@ -50,20 +69,32 @@ __all__ = [
     "BlueGreenDeployer",
     "DeadlineExceeded",
     "Decision",
+    "DecisionFleet",
     "DeployError",
+    "DrainWhilePausedError",
     "EngineBundle",
+    "FleetBundle",
+    "FleetConfig",
+    "FleetError",
     "InferenceEngine",
     "MicroBatcher",
+    "NoHealthyReplicaError",
     "ParityProbeError",
+    "ReplicaSupervisor",
     "RequestRecord",
     "ServeConfig",
+    "SessionStateStore",
     "ShedError",
     "WeightSwapError",
     "batcher_from_config",
     "bluegreen_from_config",
+    "decision_bytes",
     "engine_from_config",
+    "fleet_config_from",
+    "fleet_from_config",
     "flatten_obs_host",
     "make_host_encoder",
+    "params_digest",
     "resolve_batch_mode",
     "serve_config_from",
     "tokens_from_obs_host",

@@ -49,6 +49,7 @@ from gymfx_tpu.resilience.retry import CircuitOpenError
 from gymfx_tpu.serve.overload import (
     BatcherClosedError,
     DeadlineExceeded,
+    DrainWhilePausedError,
     ShedError,
     resolve_shed_policy,
 )
@@ -326,40 +327,74 @@ class MicroBatcher:
             self._paused = False
             self._cv.notify_all()
 
+    # how long drain() waits for a concurrent resume() before deciding a
+    # paused batcher with queued work is a deadlock, not a flush in
+    # progress (tests shrink this on the instance)
+    paused_drain_grace_s: float = 5.0
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful shutdown, phase 1: stop admissions (submit raises
         :class:`BatcherClosedError`) and wait for the queued + in-flight
         work to flush through the engine.  Returns True when fully
         drained within ``timeout`` seconds (None = wait forever); the
-        caller then calls :meth:`close` for phase 2."""
+        caller then calls :meth:`close` for phase 2.
+
+        A drain while ``pause()``d cannot make progress — the worker is
+        parked at the micro-batch boundary and queued requests stay
+        queued forever.  Instead of waiting on that parked worker
+        (``timeout=None`` used to hang here), the drain waits a bounded
+        grace (``min(timeout, paused_drain_grace_s)``) for a concurrent
+        ``resume()`` and then raises :class:`DrainWhilePausedError`."""
         end = None if timeout is None else time.perf_counter() + timeout
         with self._cv:
             self._draining = True
             self._cv.notify_all()
+            paused_end: Optional[float] = None
             while self._pending or self._inflight:
                 if self._stop:
                     break
+                now = time.perf_counter()
+                if self._paused and self._pending:
+                    if paused_end is None:
+                        paused_end = now + self.paused_drain_grace_s
+                        if end is not None:
+                            paused_end = min(paused_end, end)
+                    if now >= paused_end:
+                        raise DrainWhilePausedError(
+                            "drain() while paused: the worker is parked "
+                            "at the micro-batch boundary and "
+                            f"{len(self._pending)} queued request(s) "
+                            "cannot flush; resume() before draining"
+                        )
+                    self._cv.wait(paused_end - now)
+                    continue
+                paused_end = None
                 if end is None:
                     self._cv.wait()
                 else:
-                    remaining = end - time.perf_counter()
+                    remaining = end - now
                     if remaining <= 0:
                         return False
                     self._cv.wait(remaining)
             return not self._pending and not self._inflight
 
-    def close(self) -> None:
+    def close(self, timeout: Optional[float] = None) -> None:
         """Stop the worker and FAIL every request still queued with
         :class:`BatcherClosedError` — a closed batcher never leaves a
         caller blocked on ``future.result()``.  Bounded by at most one
-        in-flight dispatch; idempotent."""
+        in-flight dispatch; idempotent.
+
+        ``timeout`` bounds the worker join: a wedged dispatch (stalled
+        engine) cannot block the close — queued requests are failed
+        immediately and the daemon worker exits whenever its dispatch
+        finally returns (the fleet's kill path relies on this)."""
         with self._cv:
             if self._closed:
                 return
             self._closed = True
             self._stop = True
             self._cv.notify_all()
-        self._worker.join()
+        self._worker.join(timeout)
         with self._cv:
             leftovers = list(self._pending)
             self._pending.clear()

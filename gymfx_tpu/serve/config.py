@@ -30,6 +30,23 @@ class ServeConfig(NamedTuple):
     swap_parity_probe: int            # pinned-obs rows per shadow-parity probe; 0 = off
 
 
+class FleetConfig(NamedTuple):
+    """The ``serve_fleet_*`` keys (docs/serving.md, "Decision fleet").
+    ``replicas == 0`` means the fleet is off and serving stays the
+    single engine + micro-batcher path."""
+
+    replicas: int                     # active replicas; 0 = fleet off
+    standbys: int                     # warm spares promoted on failover
+    max_queue: Optional[int]          # fleet-wide queued-request gate; None = off
+    probe_interval_s: float           # supervisor probe cadence
+    probe_timeout_s: float            # per-probe timeout -> probe failure
+    probe_rows: int                   # pinned-obs rows per probe dispatch
+    degraded_latency_ms: float        # slow-probe threshold -> degraded
+    dead_after: int                   # consecutive probe failures -> dead
+    retry_limit: int                  # replica-death re-routes per request
+    max_sessions: int                 # SessionStateStore LRU capacity
+
+
 def _parse_buckets(value: Any) -> Tuple[int, ...]:
     """Bucket ladders arrive as real lists from file configs and as JSON
     strings from the CLI passthrough (same convention as
@@ -99,4 +116,47 @@ def serve_config_from(config: Dict[str, Any]) -> ServeConfig:
         breaker_recovery_s=recovery,
         feed_stale_after_s=_opt_positive(config, "feed_stale_after_s", float),
         swap_parity_probe=probe,
+    )
+
+
+def fleet_config_from(config: Dict[str, Any]) -> FleetConfig:
+    replicas = int(config.get("serve_fleet_replicas", 0) or 0)
+    if replicas < 0:
+        raise ValueError(
+            f"serve_fleet_replicas must be >= 0 (0 disables), got {replicas}"
+        )
+    standbys = int(config.get("serve_fleet_standbys", 1) or 0)
+    if standbys < 0:
+        raise ValueError(
+            f"serve_fleet_standbys must be >= 0, got {standbys}"
+        )
+    interval = float(config.get("serve_fleet_probe_interval_s", 0.25) or 0.25)
+    timeout = float(config.get("serve_fleet_probe_timeout_s", 2.0) or 2.0)
+    if interval <= 0 or timeout <= 0:
+        raise ValueError(
+            "serve_fleet_probe_interval_s and serve_fleet_probe_timeout_s "
+            f"must be > 0, got {interval} / {timeout}"
+        )
+    rows = int(config.get("serve_fleet_probe_rows", 2) or 1)
+    degraded = float(config.get("serve_fleet_degraded_latency_ms", 250.0) or 250.0)
+    dead_after = int(config.get("serve_fleet_dead_after", 1) or 1)
+    retries = int(config.get("serve_fleet_retry_limit", 2) or 0)
+    sessions = int(config.get("serve_fleet_max_sessions", 1_000_000) or 1)
+    if rows < 1 or degraded <= 0 or dead_after < 1 or retries < 0 or sessions < 1:
+        raise ValueError(
+            "fleet knobs out of range: probe_rows >= 1, "
+            "degraded_latency_ms > 0, dead_after >= 1, retry_limit >= 0, "
+            "max_sessions >= 1"
+        )
+    return FleetConfig(
+        replicas=replicas,
+        standbys=standbys,
+        max_queue=_opt_positive(config, "serve_fleet_max_queue", int),
+        probe_interval_s=interval,
+        probe_timeout_s=timeout,
+        probe_rows=rows,
+        degraded_latency_ms=degraded,
+        dead_after=dead_after,
+        retry_limit=retries,
+        max_sessions=sessions,
     )

@@ -74,10 +74,12 @@ class RollbackResult(NamedTuple):
     verified: bool        # pinned-obs replay bitwise equal to snapshot
 
 
-def _decision_bytes(decision: Any) -> bytes:
+def decision_bytes(decision: Any) -> bytes:
     """Canonical byte string of a Decision (order-stable over the tree
     leaves) — equality of these IS bitwise equality of the decision
-    stream on the pinned batch."""
+    stream on the pinned batch.  Shared by the deployer's parity probes,
+    the decision fleet's failover verification and the chaos harnesses'
+    carry-parity pins."""
     import jax
 
     parts = []
@@ -89,7 +91,7 @@ def _decision_bytes(decision: Any) -> bytes:
     return b"\0".join(parts)
 
 
-def _all_finite(decision: Any) -> bool:
+def all_finite(decision: Any) -> bool:
     import jax
 
     for leaf in jax.tree.leaves(tuple(decision)):
@@ -99,6 +101,11 @@ def _all_finite(decision: Any) -> bool:
         ):
             return False
     return True
+
+
+# pre-fleet private names, kept for callers that imported them
+_decision_bytes = decision_bytes
+_all_finite = all_finite
 
 
 class BlueGreenDeployer:

@@ -1,0 +1,1184 @@
+"""Fault-tolerant decision fleet: N replicas behind one admission
+front-end (docs/serving.md, "Decision fleet").
+
+The single engine + micro-batcher pair survives overload (typed sheds,
+deadlines, a breaker) but not the engine itself dying: one stalled
+dispatch takes the whole serving path down.  The fleet closes that gap:
+
+  DecisionFleet      supervises N ``InferenceEngine`` + ``MicroBatcher``
+                     replicas plus warm standbys.  One ``submit()``
+                     front-end routes requests (session-affine for
+                     carry-bearing policies, hash for stateless
+                     sessions, round-robin otherwise), gates fleet-wide
+                     queue depth, and re-routes requests stranded on a
+                     dead replica so every submitted request still
+                     resolves — with a Decision or one typed overload
+                     error, never a hang;
+  SessionStateStore  keeps each session's recurrent carry HOST-SIDE
+                     after every decision, so failover re-pins a session
+                     to a surviving replica with its carry intact — in
+                     ``exact`` batch mode the decision stream is then
+                     bitwise identical to an unfailed run (pinned in
+                     tests/test_serve_fleet.py);
+  ReplicaSupervisor  health-probes every replica on a cadence with the
+                     same pinned-obs machinery the blue/green parity
+                     probe uses, classifies healthy/degraded/dead from
+                     probe latency, breaker state and ``late_compiles``,
+                     and fails dead replicas over to standbys.
+
+Failover is drain-or-kill: the dead replica's batcher gets a bounded
+drain, then a bounded-join close (queued futures fail typed and are
+immediately re-routed), a standby verified against the fleet's weight
+identity (params digest, plus the checkpoint digest via
+``verify_checkpoint`` when a checkpoint dir is configured) is promoted
+in its place, and the whole transition lands in the run ledger as
+``replica_down`` / ``replica_failover`` / ``replica_up`` rows.
+
+Fleet-wide deployment keeps the continuous-learning controller
+unchanged: :meth:`DecisionFleet.promote` / :meth:`rollback` /
+:meth:`demote` present the same surface as ``BlueGreenDeployer`` but
+swap weights across EVERY replica and standby (ROADMAP item 4), with
+per-replica pinned-obs snapshots making rollback bitwise-verifiable.
+
+With ``serve_fleet_replicas`` at 0 (the default) none of this is
+constructed and serving is the single-replica path, bitwise identical
+to the pre-fleet code.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FuturesTimeout
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from gymfx_tpu.resilience.retry import CircuitOpenError
+from gymfx_tpu.serve.config import FleetConfig, fleet_config_from
+from gymfx_tpu.serve.deploy import RollbackResult, all_finite, decision_bytes
+from gymfx_tpu.serve.overload import (
+    BatcherClosedError,
+    DeadlineExceeded,
+    NoHealthyReplicaError,
+    ShedError,
+)
+
+REPLICA_STATES = ("healthy", "degraded", "dead")
+
+
+class FleetError(RuntimeError):
+    """Fleet lifecycle misuse (unknown replica, no rollback armed, ...)."""
+
+
+def params_digest(params: Any) -> str:
+    """sha256 over the param tree leaves (dtype, shape, bytes in tree
+    order) — the weight-identity stamp every replica and standby must
+    share, and what failover verifies before promoting a spare."""
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(params):
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _copy_carry(carry: Any) -> Any:
+    """Host-side copy of a carry tree: the store must own its arrays,
+    not views into a fetched device batch that the next dispatch may
+    reuse."""
+    import jax
+
+    return jax.tree.map(lambda x: np.array(x), carry)
+
+
+def _fulfil(fut: Future, value: Any) -> bool:
+    try:
+        fut.set_result(value)
+        return True
+    except InvalidStateError:
+        return False
+
+
+def _fail(fut: Future, exc: BaseException) -> bool:
+    try:
+        fut.set_exception(exc)
+        return True
+    except InvalidStateError:
+        return False
+
+
+class SessionStateStore:
+    """Thread-safe host-side session state: recurrent carry + replica
+    affinity, LRU-bounded at ``max_sessions`` (evictions restart the
+    evicted session's carry from initial — counted, never silent)."""
+
+    def __init__(self, max_sessions: int = 1_000_000):
+        if max_sessions < 1:
+            raise ValueError(f"max_sessions must be >= 1, got {max_sessions}")
+        self.max_sessions = int(max_sessions)
+        self._lock = threading.Lock()
+        self._sessions: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.evictions = 0
+
+    def _entry(self, session: str) -> Dict[str, Any]:
+        entry = self._sessions.get(session)
+        if entry is None:
+            entry = {"carry": None, "replica": None}
+            self._sessions[session] = entry
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+                self.evictions += 1
+        else:
+            self._sessions.move_to_end(session)
+        return entry
+
+    def carry(self, session: str) -> Any:
+        with self._lock:
+            entry = self._sessions.get(session)
+            if entry is None:
+                return None
+            self._sessions.move_to_end(session)
+            return entry["carry"]
+
+    def replica(self, session: str) -> Optional[int]:
+        with self._lock:
+            entry = self._sessions.get(session)
+            return None if entry is None else entry["replica"]
+
+    def record_decision(self, session: str, carry: Any) -> None:
+        """Store the post-decision carry (copied host-side)."""
+        copied = _copy_carry(carry)
+        with self._lock:
+            self._entry(session)["carry"] = copied
+
+    def pin(self, session: str, replica_id: int) -> None:
+        with self._lock:
+            self._entry(session)["replica"] = int(replica_id)
+
+    def unpin_replica(self, replica_id: int) -> List[str]:
+        """Clear the affinity of every session pinned to ``replica_id``
+        (their carries stay; the next submit re-pins them to a healthy
+        replica).  Returns the affected session ids."""
+        moved = []
+        with self._lock:
+            for session, entry in self._sessions.items():
+                if entry["replica"] == replica_id:
+                    entry["replica"] = None
+                    moved.append(session)
+        return moved
+
+    def sessions_on(self, replica_id: int) -> List[str]:
+        with self._lock:
+            return [
+                s for s, e in self._sessions.items()
+                if e["replica"] == replica_id
+            ]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+
+class Replica:
+    """One supervised serving lane: engine + micro-batcher + the
+    supervisor's view of it."""
+
+    def __init__(self, replica_id: int, engine: Any, batcher: Any):
+        self.id = int(replica_id)
+        self.engine = engine
+        self.batcher = batcher
+        self.state = "healthy"
+        self.probe_failures = 0          # consecutive failed probes
+        self.last_probe_latency_s: Optional[float] = None
+        self.last_probe_error: Optional[str] = None
+        self.decided = 0                 # requests this lane resolved
+
+    def queue_depth(self) -> int:
+        # len() on a deque is atomic; safe without the batcher lock
+        return len(self.batcher._pending)
+
+
+class _FleetRequest:
+    """One front-end request: the outer future the caller holds plus
+    enough context to re-route after a replica death."""
+
+    __slots__ = ("obs", "carry", "session", "deadline_ms", "outer",
+                 "attempts", "replica_id")
+
+    def __init__(self, obs, carry, session, deadline_ms):
+        self.obs = obs
+        self.carry = carry               # caller-managed carry or None
+        self.session = session
+        self.deadline_ms = deadline_ms
+        self.outer: Future = Future()
+        self.attempts = 0
+        self.replica_id: Optional[int] = None
+
+
+class FleetPromoteResult(NamedTuple):
+    generation: int
+    step: int
+    digest: Optional[str]
+    swap_latency_s: float
+    replicas: int        # lanes the new weights were swapped into
+
+
+class DecisionFleet:
+    """N replicas + warm standbys behind one admission front-end.
+
+    Parameters
+    ----------
+    engines : the active replicas' warm engines (identical policy,
+        buckets, batch mode and boot weights — verified by digest)
+    batcher_factory : ``(engine, replica_id) -> MicroBatcher`` — called
+        for every boot replica AND every promoted standby, so chaos
+        wrapping and per-replica instruments ride one path
+    standby_engines : warm spares, promoted in order on failover
+    max_queue : fleet-wide queued-request gate (sum of replica queue
+        depths); None = no fleet gate (per-batcher admission still
+        applies)
+    retry_limit : replica-death re-routes per request before its future
+        fails with the underlying error
+    probe_rows : pinned-obs rows per health probe / promote snapshot
+    checkpoint_dir : when set, failover additionally re-verifies this
+        checkpoint's digest (``verify_checkpoint``) before promoting a
+        standby
+    """
+
+    def __init__(
+        self,
+        engines: Sequence[Any],
+        batcher_factory: Callable[[Any, int], Any],
+        *,
+        standby_engines: Sequence[Any] = (),
+        session_store: Optional[SessionStateStore] = None,
+        max_queue: Optional[int] = None,
+        retry_limit: int = 2,
+        probe_rows: int = 2,
+        checkpoint_dir: Optional[str] = None,
+        ledger: Optional[Any] = None,
+        registry: Optional[Any] = None,
+        seed: int = 0,
+        drain_timeout_s: float = 2.0,
+        close_timeout_s: float = 1.0,
+        name: str = "fleet",
+    ):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("DecisionFleet needs at least one engine")
+        self.name = str(name)
+        self._factory = batcher_factory
+        self.store = session_store or SessionStateStore()
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.retry_limit = int(retry_limit)
+        self.checkpoint_dir = None if checkpoint_dir is None else str(checkpoint_dir)
+        self.ledger = ledger
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.close_timeout_s = float(close_timeout_s)
+
+        # one weight identity across the whole fleet, pinned at boot
+        self.weights_digest = params_digest(engines[0].params)
+        for eng in list(engines[1:]) + list(standby_engines):
+            if params_digest(eng.params) != self.weights_digest:
+                raise FleetError(
+                    "fleet replicas/standbys must boot from one weight "
+                    "identity (params digests differ)"
+                )
+        self.checkpoint_digest: Optional[str] = None
+        self.active_step: Optional[int] = None
+        if self.checkpoint_dir is not None:
+            from gymfx_tpu.train.checkpoint import verify_checkpoint
+
+            try:
+                step, digest = verify_checkpoint(self.checkpoint_dir)
+            except FileNotFoundError:
+                # a configured-but-empty checkpoint dir means the fleet
+                # booted from fresh params: nothing on disk to pin
+                # failover verification to (integrity errors still raise)
+                self.checkpoint_dir = None
+            else:
+                self.checkpoint_digest = digest
+                self.active_step = int(step)
+
+        self._lock = threading.RLock()
+        self._active: "OrderedDict[int, Replica]" = OrderedDict()
+        self._dead: Dict[int, Replica] = {}
+        self._outstanding: Dict[int, set] = {}
+        self._standby_engines: List[Any] = list(standby_engines)
+        self._rr = 0
+        self._closed = False
+        self._armed: Optional[Dict[str, Any]] = None
+
+        self.generation = 0
+        self.promote_count = 0
+        self.submitted = 0
+        self.decided = 0
+        self.fleet_shed_count = 0
+        self.reroutes = 0
+        self.failovers = 0
+        self.failover_records: List[Dict[str, Any]] = []
+
+        # session affinity is a property of the POLICY, not the request:
+        # carry-bearing (recurrent) policies pin sessions, stateless
+        # ones hash-route
+        self.affine = bool(engines[0].recurrent)
+
+        # the pinned probe batch every health probe and promote snapshot
+        # runs against (seeded — two fleets with the same seed pin the
+        # same batch, which is what makes chaos parity runs comparable)
+        rows = max(1, int(probe_rows))
+        rng = np.random.default_rng(int(seed))
+        self._pinned_obs = rng.standard_normal(
+            (rows, *engines[0].obs_shape)
+        ).astype(engines[0].obs_dtype)
+
+        self._replicas_gauge = None
+        self._failover_counter = self._shed_counter = None
+        self._reroute_counter = self._generation_gauge = None
+        if registry is not None:
+            self._replicas_gauge = registry.gauge(
+                "gymfx_fleet_replicas",
+                "fleet replicas by supervisor state (read at scrape time)",
+                labels=("state",),
+            )
+            for state in REPLICA_STATES:
+                self._replicas_gauge.set_function(
+                    (lambda s: (lambda: float(self._state_count(s))))(state),
+                    state=state,
+                )
+            self._failover_counter = registry.counter(
+                "gymfx_fleet_failovers_total",
+                "dead replicas failed over (standby promoted or traffic "
+                "redistributed)",
+            )
+            self._shed_counter = registry.counter(
+                "gymfx_fleet_shed_total",
+                "requests shed by the fleet-wide queue-depth gate",
+            )
+            self._reroute_counter = registry.counter(
+                "gymfx_fleet_reroutes_total",
+                "requests re-routed to a surviving replica after a "
+                "replica failure",
+            )
+            self._generation_gauge = registry.gauge(
+                "gymfx_fleet_generation",
+                "fleet-wide serving policy generation (0 = boot policy)",
+            )
+            self._generation_gauge.set(0.0)
+
+        next_id = 0
+        for eng in engines:
+            self._install_replica(eng, replica_id=next_id, record=False)
+            next_id += 1
+        self._next_id = next_id + len(self._standby_engines)
+        self._standby_ids = list(
+            range(next_id, next_id + len(self._standby_engines))
+        )
+
+    # ------------------------------------------------------------------
+    # construction / teardown
+    def _install_replica(
+        self,
+        engine: Any,
+        *,
+        replica_id: Optional[int] = None,
+        record: bool = True,
+    ) -> Replica:
+        with self._lock:
+            if replica_id is None:
+                replica_id = self._next_id
+                self._next_id += 1
+        batcher = self._factory(engine, replica_id)
+        replica = Replica(replica_id, engine, batcher)
+        with self._lock:
+            self._active[replica_id] = replica
+        if record:
+            self._record(
+                "replica_up", replica=replica_id, generation=self.generation
+            )
+        return replica
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            replicas = list(self._active.values())
+        for replica in replicas:
+            replica.batcher.close(self.close_timeout_s)
+        with self._lock:
+            stranded = [
+                req
+                for reqs in self._outstanding.values()
+                for req in reqs
+                if not req.outer.done()
+            ]
+            self._outstanding.clear()
+        for req in stranded:
+            _fail(req.outer, BatcherClosedError("DecisionFleet closed"))
+
+    def __enter__(self) -> "DecisionFleet":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    # introspection
+    @property
+    def engine(self) -> Any:
+        """The first active replica's engine (single-engine tooling
+        compatibility: obs shape/dtype, late_compiles reads)."""
+        with self._lock:
+            for replica in self._active.values():
+                return replica.engine
+        raise FleetError("no active replicas")
+
+    def active_replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._active.values())
+
+    def replica(self, replica_id: int) -> Replica:
+        with self._lock:
+            rep = self._active.get(replica_id) or self._dead.get(replica_id)
+        if rep is None:
+            raise FleetError(f"unknown replica {replica_id}")
+        return rep
+
+    def dead_replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._dead.values())
+
+    def standby_count(self) -> int:
+        with self._lock:
+            return len(self._standby_engines)
+
+    def queue_depth(self) -> int:
+        """Total queued (not yet picked up) requests across the fleet —
+        what the fleet-wide admission gate reads."""
+        with self._lock:
+            return sum(r.queue_depth() for r in self._active.values())
+
+    def _state_count(self, state: str) -> int:
+        with self._lock:
+            if state == "dead":
+                return len(self._dead)
+            return sum(
+                1 for r in self._active.values() if r.state == state
+            )
+
+    def health(self) -> Dict[str, Any]:
+        with self._lock:
+            replicas = {
+                r.id: {
+                    "state": r.state,
+                    "queue_depth": r.queue_depth(),
+                    "decided": r.decided,
+                    "probe_latency_s": r.last_probe_latency_s,
+                    "probe_error": r.last_probe_error,
+                    "late_compiles": int(
+                        getattr(r.engine, "late_compiles", 0)
+                    ),
+                }
+                for r in list(self._active.values()) + list(self._dead.values())
+            }
+            return {
+                "replicas": replicas,
+                "standbys": len(self._standby_engines),
+                "sessions": len(self.store),
+                "submitted": self.submitted,
+                "decided": self.decided,
+                "fleet_shed": self.fleet_shed_count,
+                "reroutes": self.reroutes,
+                "failovers": self.failovers,
+                "generation": self.generation,
+                "queue_depth": self.queue_depth(),
+            }
+
+    def _record(self, kind: str, **fields: Any) -> None:
+        if self.ledger is not None:
+            self.ledger.record(kind, **fields)
+        if self._generation_gauge is not None:
+            self._generation_gauge.set(float(self.generation))
+
+    # ------------------------------------------------------------------
+    # routing + submission
+    def submit(
+        self,
+        obs_row: Any,
+        carry: Any = None,
+        *,
+        session: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Future:
+        """Route one encoded observation to a replica; returns a Future
+        resolving to its Decision row or failing with one typed overload
+        error — never hanging, including across a replica death (the
+        request is transparently re-routed up to ``retry_limit`` times).
+
+        ``session`` keys the carry store: carry-bearing policies pin
+        the session to a replica and the store supplies/updates its
+        carry around every decision (sessions submit serially — the
+        next decision only after the previous resolved).  An explicit
+        ``carry`` bypasses the store (caller-managed state)."""
+        with self._lock:
+            if self._closed:
+                raise BatcherClosedError("DecisionFleet is closed")
+            if self.max_queue is not None:
+                depth = sum(r.queue_depth() for r in self._active.values())
+                if depth >= self.max_queue:
+                    self.fleet_shed_count += 1
+                    if self._shed_counter is not None:
+                        self._shed_counter.inc()
+                    raise ShedError(
+                        f"fleet queue depth {depth} at capacity "
+                        f"({self.max_queue}); request rejected",
+                        reason="fleet_queue_full",
+                    )
+            self.submitted += 1
+        req = _FleetRequest(
+            np.asarray(obs_row),
+            carry,
+            None if session is None else str(session),
+            deadline_ms,
+        )
+        self._route(req)
+        return req.outer
+
+    def decide(
+        self,
+        obs_row: Any,
+        *,
+        session: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        timeout: Optional[float] = 30.0,
+    ) -> Any:
+        """Blocking single-decision convenience over :meth:`submit`."""
+        return self.submit(
+            obs_row, session=session, deadline_ms=deadline_ms
+        ).result(timeout)
+
+    def _pick_replica(
+        self, session: Optional[str], exclude: Sequence[int] = ()
+    ) -> Optional[Replica]:
+        with self._lock:
+            live = [
+                r for r in self._active.values() if r.id not in exclude
+            ]
+            healthy = [r for r in live if r.state == "healthy"]
+            pool = healthy or [r for r in live if r.state == "degraded"]
+            # a fleet that is all-degraded still serves: degraded means
+            # "avoid for NEW placements", not "refuse traffic"
+            if not pool:
+                return None
+            if session is not None and self.affine:
+                pinned = self.store.replica(session)
+                if pinned is not None and pinned not in exclude:
+                    rep = self._active.get(pinned)
+                    if rep is not None:
+                        # affinity beats degraded-avoidance: moving the
+                        # session is the costlier disruption
+                        return rep
+                rep = pool[zlib.crc32(session.encode()) % len(pool)]
+                self.store.pin(session, rep.id)
+                return rep
+            if session is not None:
+                return pool[zlib.crc32(session.encode()) % len(pool)]
+            self._rr += 1
+            return pool[self._rr % len(pool)]
+
+    def _route(self, req: _FleetRequest, exclude: Sequence[int] = ()) -> None:
+        replica = self._pick_replica(req.session, exclude)
+        if replica is None:
+            _fail(
+                req.outer,
+                NoHealthyReplicaError(
+                    "no healthy or degraded replica available to route to"
+                ),
+            )
+            return
+        carry = req.carry
+        if carry is None and req.session is not None and self.affine:
+            carry = self.store.carry(req.session)
+        req.replica_id = replica.id
+        with self._lock:
+            self._outstanding.setdefault(replica.id, set()).add(req)
+        try:
+            inner = replica.batcher.submit(
+                req.obs, carry, deadline_ms=req.deadline_ms
+            )
+        except (ShedError, DeadlineExceeded) as exc:
+            # per-replica admission decisions are typed resolutions,
+            # not failures to route around
+            self._discard(replica.id, req)
+            _fail(req.outer, exc)
+            return
+        except Exception as exc:
+            # raced a kill (BatcherClosedError) or the lane is broken:
+            # try a surviving replica
+            self._discard(replica.id, req)
+            self._retry_or_fail(req, exc)
+            return
+        inner.add_done_callback(
+            lambda fut, r=req, rid=replica.id: self._on_inner_done(
+                r, rid, fut
+            )
+        )
+
+    def _discard(self, replica_id: int, req: _FleetRequest) -> None:
+        with self._lock:
+            reqs = self._outstanding.get(replica_id)
+            if reqs is not None:
+                reqs.discard(req)
+
+    def _on_inner_done(
+        self, req: _FleetRequest, replica_id: int, inner: Future
+    ) -> None:
+        self._discard(replica_id, req)
+        if req.outer.done():
+            # already handed off by a failover sweep; a late resolution
+            # from the wedged lane is dropped on the floor
+            return
+        if inner.cancelled():
+            self._retry_or_fail(
+                req,
+                BatcherClosedError(
+                    f"replica {replica_id} killed with the request queued"
+                ),
+            )
+            return
+        exc = inner.exception()
+        if exc is None:
+            decision = inner.result()
+            if _fulfil(req.outer, decision):
+                with self._lock:
+                    self.decided += 1
+                    rep = self._active.get(replica_id) or self._dead.get(
+                        replica_id
+                    )
+                    if rep is not None:
+                        rep.decided += 1
+                if (
+                    req.session is not None
+                    and req.carry is None
+                    and self.affine
+                ):
+                    self.store.record_decision(req.session, decision.carry)
+            return
+        if isinstance(exc, (ShedError, DeadlineExceeded)):
+            # typed overload semantics propagate unchanged — retrying a
+            # shed would defeat admission control
+            _fail(req.outer, exc)
+            return
+        self._retry_or_fail(req, exc)
+
+    def _retry_or_fail(self, req: _FleetRequest, exc: BaseException) -> None:
+        if req.outer.done():
+            return
+        req.attempts += 1
+        with self._lock:
+            closed = self._closed
+        if closed or req.attempts > self.retry_limit:
+            _fail(req.outer, exc)
+            return
+        with self._lock:
+            self.reroutes += 1
+        if self._reroute_counter is not None:
+            self._reroute_counter.inc()
+        exclude = () if req.replica_id is None else (req.replica_id,)
+        self._route(req, exclude)
+
+    # ------------------------------------------------------------------
+    # health probes
+    def probe_replica(
+        self, replica: Replica, *, timeout_s: float = 2.0
+    ) -> Dict[str, Any]:
+        """Dispatch the pinned probe batch through the replica's REAL
+        request path (batcher submit, coalescing, breaker) and judge the
+        result.  Never blocks past ``timeout_s`` — a wedged lane is a
+        probe failure, not a wedged supervisor."""
+        t0 = time.perf_counter()
+        try:
+            futures = [
+                replica.batcher.submit(row, deadline_ms=timeout_s * 1e3)
+                for row in self._pinned_obs
+            ]
+        except Exception as exc:
+            return {
+                "ok": False,
+                "latency_s": time.perf_counter() - t0,
+                "error": type(exc).__name__,
+            }
+        error = None
+        try:
+            for fut in futures:
+                remaining = timeout_s - (time.perf_counter() - t0)
+                if remaining <= 0:
+                    raise FuturesTimeout()
+                decision = fut.result(remaining)
+                if not all_finite(decision):
+                    error = "nonfinite"
+                    break
+        except CircuitOpenError:
+            error = "breaker_open"
+        except FuturesTimeout:
+            error = "timeout"
+        except Exception as exc:
+            error = type(exc).__name__
+        latency = time.perf_counter() - t0
+        return {"ok": error is None, "latency_s": latency, "error": error}
+
+    def _decide_pinned(self, engine: Any) -> Any:
+        carries = (
+            engine.initial_carry_batch(self._pinned_obs.shape[0])
+            if engine.recurrent
+            else None
+        )
+        return engine.decide_batch(self._pinned_obs, carries)
+
+    # ------------------------------------------------------------------
+    # failover
+    def fail_over(
+        self, replica_id: int, *, reason: str = "manual"
+    ) -> Dict[str, Any]:
+        """Kill replica ``replica_id`` and keep every request whole:
+        the lane is removed from routing, its batcher drained-or-killed
+        (queued futures fail typed and re-route immediately), stranded
+        in-flight requests are re-dispatched to survivors, affine
+        sessions are unpinned (their carries survive in the store), and
+        the first standby — verified against the fleet weight identity
+        — is promoted in its place."""
+        with self._lock:
+            replica = self._active.pop(replica_id, None)
+            if replica is None:
+                raise FleetError(
+                    f"replica {replica_id} is not active (already dead?)"
+                )
+            replica.state = "dead"
+            self._dead[replica_id] = replica
+            standby_engine = (
+                self._standby_engines.pop(0) if self._standby_engines else None
+            )
+            standby_id = self._standby_ids.pop(0) if self._standby_ids else None
+            self.failovers += 1
+        if self._failover_counter is not None:
+            self._failover_counter.inc()
+        self._record("replica_down", replica=replica_id, reason=str(reason))
+        moved_sessions = self.store.unpin_replica(replica_id)
+
+        # drain-or-kill: give in-flight work a bounded chance to flush,
+        # then close without waiting on a possibly-wedged worker — close
+        # fails the queued futures, whose callbacks re-route them
+        try:
+            replica.batcher.drain(self.drain_timeout_s)
+        except Exception:
+            pass
+        replica.batcher.close(self.close_timeout_s)
+
+        promoted: Optional[Replica] = None
+        verified = False
+        if standby_engine is not None:
+            verified = self._verify_standby(standby_engine)
+            promoted = self._install_replica(
+                standby_engine, replica_id=standby_id, record=False
+            )
+            self._record(
+                "replica_failover",
+                replica=replica_id,
+                standby=promoted.id,
+                verified=bool(verified),
+                reason=str(reason),
+            )
+            self._record(
+                "replica_up", replica=promoted.id, generation=self.generation
+            )
+            with self._lock:
+                self.failover_records.append(
+                    {
+                        "replica": replica_id,
+                        "standby": promoted.id,
+                        "verified": bool(verified),
+                        "reason": str(reason),
+                    }
+                )
+
+        # redistribute requests stranded in flight on the dead lane (a
+        # wedged dispatch may never resolve their inner futures); late
+        # duplicate resolutions are dropped by the outer-done guard
+        with self._lock:
+            stranded = [
+                r
+                for r in self._outstanding.pop(replica_id, set())
+                if not r.outer.done()
+            ]
+        for req in stranded:
+            self._retry_or_fail(
+                req,
+                BatcherClosedError(
+                    f"replica {replica_id} killed with the request in flight"
+                ),
+            )
+        return {
+            "replica": replica_id,
+            "standby": None if promoted is None else promoted.id,
+            "verified": bool(verified),
+            "moved_sessions": len(moved_sessions),
+            "redistributed": len(stranded),
+        }
+
+    def _verify_standby(self, engine: Any) -> bool:
+        """A standby is promotable when it carries the fleet's current
+        weight identity — params digest equality, plus (when a
+        checkpoint dir is configured) the on-disk checkpoint still
+        digest-verifying to what the fleet serves."""
+        try:
+            ok = params_digest(engine.params) == self.weights_digest
+            if ok and self.checkpoint_dir is not None:
+                from gymfx_tpu.train.checkpoint import verify_checkpoint
+
+                _, digest = verify_checkpoint(self.checkpoint_dir)
+                ok = (
+                    self.checkpoint_digest is None
+                    or digest == self.checkpoint_digest
+                )
+            return bool(ok)
+        except Exception:
+            return False
+
+    # ------------------------------------------------------------------
+    # fleet-wide deployment (the BlueGreenDeployer surface, ROADMAP 4)
+    def promote(self, checkpoint_dir: str) -> FleetPromoteResult:
+        """Digest-verify ``checkpoint_dir`` and hot-swap its weights
+        into EVERY active replica and standby (honor-or-reject per
+        engine; any failure rolls the already-swapped lanes back and
+        re-raises).  Pre-swap pinned-obs snapshots per replica arm a
+        bitwise-verifiable :meth:`rollback`."""
+        from gymfx_tpu.train.checkpoint import load_params, verify_checkpoint
+
+        step, digest = verify_checkpoint(str(checkpoint_dir))
+        params, loaded_step = load_params(str(checkpoint_dir))
+        step = int(loaded_step if loaded_step else step)
+        with self._lock:
+            targets = list(self._active.values())
+            spares = list(self._standby_engines)
+        if not targets:
+            raise FleetError("no active replicas to promote into")
+        snapshots = {
+            rep.id: decision_bytes(self._decide_pinned(rep.engine))
+            for rep in targets
+        }
+        old_params = targets[0].engine.params
+        t0 = time.perf_counter()
+        swapped: List[Any] = []
+        try:
+            for rep in targets:
+                rep.engine.swap_weights(params)
+                swapped.append(rep.engine)
+            for eng in spares:
+                eng.swap_weights(params)
+                swapped.append(eng)
+        except Exception:
+            for eng in swapped:
+                eng.swap_weights(old_params, probe=False)
+            raise
+        swap_latency_s = time.perf_counter() - t0
+        self._armed = {
+            "params": old_params,
+            "snapshots": snapshots,
+            "generation": self.generation,
+            "weights_digest": self.weights_digest,
+            "checkpoint_digest": self.checkpoint_digest,
+            "step": self.active_step,
+        }
+        self.generation += 1
+        self.promote_count += 1
+        self.weights_digest = params_digest(params)
+        self.checkpoint_digest = digest
+        self.active_step = step
+        self._record(
+            "policy_promote",
+            generation=self.generation,
+            digest=digest,
+            step=step,
+            swap_latency_s=swap_latency_s,
+            replicas=len(targets),
+        )
+        return FleetPromoteResult(
+            self.generation, step, digest, swap_latency_s, len(targets)
+        )
+
+    @property
+    def rollback_armed(self) -> bool:
+        return self._armed is not None
+
+    def rollback(self) -> RollbackResult:
+        """Swap every lane back to the pre-promotion weights and verify
+        bitwise: each surviving replica replays the pinned batch against
+        its pre-promotion snapshot (lanes failed over since the promote
+        have no snapshot and are swapped without a replay check)."""
+        armed = self._armed
+        if armed is None:
+            raise FleetError("no previous weights armed for rollback")
+        with self._lock:
+            targets = list(self._active.values())
+            spares = list(self._standby_engines)
+        for rep in targets:
+            rep.engine.swap_weights(armed["params"])
+        for eng in spares:
+            eng.swap_weights(armed["params"])
+        verified = True
+        for rep in targets:
+            snapshot = armed["snapshots"].get(rep.id)
+            if snapshot is not None:
+                replay = decision_bytes(self._decide_pinned(rep.engine))
+                verified = verified and replay == snapshot
+        self.generation = int(armed["generation"])
+        self.weights_digest = armed["weights_digest"]
+        self.checkpoint_digest = armed["checkpoint_digest"]
+        self.active_step = armed["step"]
+        self._armed = None
+        self._record(
+            "policy_rollback",
+            generation=self.generation,
+            verified=bool(verified),
+            replicas=len(targets),
+        )
+        return RollbackResult(self.generation, bool(verified))
+
+    def demote(self, reason: str) -> RollbackResult:
+        """Ledger a regression (``policy_demote``) and roll the whole
+        fleet back."""
+        self._record(
+            "policy_demote", generation=self.generation, reason=str(reason)
+        )
+        return self.rollback()
+
+
+class ReplicaSupervisor:
+    """Cadenced health probing + failover over a :class:`DecisionFleet`.
+
+    Each poll dispatches the fleet's pinned probe batch through every
+    active replica's real request path and classifies:
+
+      dead      probe timed out / raised (``dead_after`` consecutive
+                times) — failed over immediately when ``auto_failover``
+      degraded  breaker not closed, ``late_compiles`` > 0, or probe
+                latency above ``degraded_latency_ms`` — serves existing
+                affinity but is avoided for new placements
+      healthy   probe round-tripped finite, fast, breaker closed
+
+    ``poll_once()`` is callable directly (no thread) — tests and the
+    chaos harness drive it deterministically; ``start()`` runs it on a
+    daemon thread every ``interval_s``.
+    """
+
+    def __init__(
+        self,
+        fleet: DecisionFleet,
+        *,
+        interval_s: float = 0.25,
+        probe_timeout_s: float = 2.0,
+        degraded_latency_ms: float = 250.0,
+        dead_after: int = 1,
+        auto_failover: bool = True,
+    ):
+        self.fleet = fleet
+        self.interval_s = float(interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.degraded_latency_s = float(degraded_latency_ms) / 1e3
+        self.dead_after = max(1, int(dead_after))
+        self.auto_failover = bool(auto_failover)
+        self.polls = 0
+        self.failovers_triggered = 0
+        self._stop = threading.Event()
+        self._started = False
+        self._thread = threading.Thread(
+            target=self._run, name="gymfx-fleet-supervisor", daemon=True
+        )
+
+    def start(self) -> "ReplicaSupervisor":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._started:
+            self._thread.join(timeout)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                # a probe crash must never kill the supervision loop
+                pass
+
+    def poll_once(self) -> Dict[int, str]:
+        """Probe every active replica once; returns replica -> state."""
+        self.polls += 1
+        states: Dict[int, str] = {}
+        for replica in self.fleet.active_replicas():
+            result = self.fleet.probe_replica(
+                replica, timeout_s=self.probe_timeout_s
+            )
+            replica.last_probe_latency_s = result["latency_s"]
+            replica.last_probe_error = result["error"]
+            if not result["ok"]:
+                if result["error"] == "breaker_open":
+                    # the breaker recovers on its own (half-open probe);
+                    # degraded, not a step toward dead
+                    replica.probe_failures = 0
+                    replica.state = "degraded"
+                    states[replica.id] = replica.state
+                    continue
+                replica.probe_failures += 1
+                if replica.probe_failures >= self.dead_after:
+                    replica.state = "dead"
+                    states[replica.id] = "dead"
+                    if self.auto_failover:
+                        try:
+                            self.fleet.fail_over(
+                                replica.id,
+                                reason=f"probe:{result['error']}",
+                            )
+                            self.failovers_triggered += 1
+                        except FleetError:
+                            pass
+                else:
+                    replica.state = "degraded"
+                    states[replica.id] = "degraded"
+                continue
+            replica.probe_failures = 0
+            breaker = getattr(replica.batcher, "breaker", None)
+            degraded = (
+                (breaker is not None and breaker.state != "closed")
+                or int(getattr(replica.engine, "late_compiles", 0)) > 0
+                or result["latency_s"] > self.degraded_latency_s
+            )
+            replica.state = "degraded" if degraded else "healthy"
+            states[replica.id] = replica.state
+        return states
+
+
+class FleetBundle(NamedTuple):
+    """A ready decision fleet from one config dict.  ``deployer`` and
+    ``batcher`` alias the fleet so continuous-learning controllers and
+    burst drivers built for the single-replica stack work unchanged."""
+
+    fleet: DecisionFleet
+    supervisor: ReplicaSupervisor
+    bundle: Any      # replica 0's EngineBundle (env, encoder, ...)
+
+    @property
+    def deployer(self) -> DecisionFleet:
+        return self.fleet
+
+    @property
+    def batcher(self) -> DecisionFleet:
+        return self.fleet
+
+
+def _normalize_wrap(
+    wrap_engine: Optional[Callable[..., Any]]
+) -> Callable[[Any, int], Any]:
+    """Accept both the fleet's ``(engine, replica_id)`` wrappers and the
+    single-replica stack's ``(engine)`` wrappers."""
+    if wrap_engine is None:
+        return lambda engine, replica_id: engine
+    import inspect
+
+    try:
+        n_params = len(inspect.signature(wrap_engine).parameters)
+    except (TypeError, ValueError):
+        n_params = 1
+    if n_params >= 2:
+        return wrap_engine
+    return lambda engine, replica_id: wrap_engine(engine)
+
+
+def fleet_from_config(
+    config: Dict[str, Any],
+    *,
+    env: Optional[Any] = None,
+    ledger: Optional[Any] = None,
+    registry: Optional[Any] = None,
+    wrap_engine: Optional[Callable[..., Any]] = None,
+    name: str = "serve",
+) -> FleetBundle:
+    """Build a warm N-replica fleet + supervisor from the merged config
+    dict (``serve_fleet_*`` keys; docs/serving.md "Decision fleet").
+    Replicas share one env/feed and one boot weight identity; each gets
+    its own micro-batcher (and, with a registry, its own
+    replica-labeled ServeInstruments).  Raises when
+    ``serve_fleet_replicas`` < 1 — a fleet must be asked for
+    explicitly; the default config keeps single-replica serving."""
+    from gymfx_tpu.serve.batcher import batcher_from_config
+    from gymfx_tpu.serve.engine import engine_from_config
+
+    fcfg: FleetConfig = fleet_config_from(config)
+    if fcfg.replicas < 1:
+        raise ValueError(
+            "serve_fleet_replicas must be >= 1 to build a DecisionFleet "
+            "(0 keeps the single-replica serving path)"
+        )
+    wrap = _normalize_wrap(wrap_engine)
+    bundle = engine_from_config(config, env=env)
+    engines = [bundle.engine]
+    for _ in range(fcfg.replicas - 1):
+        engines.append(
+            engine_from_config(
+                config, env=bundle.env, params=bundle.engine.params
+            ).engine
+        )
+    standbys = [
+        engine_from_config(
+            config, env=bundle.env, params=bundle.engine.params
+        ).engine
+        for _ in range(fcfg.standbys)
+    ]
+    engines = [wrap(eng, i) for i, eng in enumerate(engines)]
+    standbys = [
+        wrap(eng, fcfg.replicas + j) for j, eng in enumerate(standbys)
+    ]
+
+    def batcher_factory(engine: Any, replica_id: int) -> Any:
+        instruments = None
+        if registry is not None:
+            from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+            instruments = ServeInstruments(
+                registry, name=name, replica=str(replica_id)
+            )
+        return batcher_from_config(engine, config, instruments=instruments)
+
+    fleet = DecisionFleet(
+        engines,
+        batcher_factory,
+        standby_engines=standbys,
+        session_store=SessionStateStore(max_sessions=fcfg.max_sessions),
+        max_queue=fcfg.max_queue,
+        retry_limit=fcfg.retry_limit,
+        probe_rows=fcfg.probe_rows,
+        checkpoint_dir=config.get("checkpoint_dir") or None,
+        ledger=ledger,
+        registry=registry,
+        seed=int(config.get("seed", 0) or 0),
+        name=name,
+    )
+    supervisor = ReplicaSupervisor(
+        fleet,
+        interval_s=fcfg.probe_interval_s,
+        probe_timeout_s=fcfg.probe_timeout_s,
+        degraded_latency_ms=fcfg.degraded_latency_ms,
+        dead_after=fcfg.dead_after,
+    )
+    return FleetBundle(fleet=fleet, supervisor=supervisor, bundle=bundle)

@@ -64,6 +64,22 @@ class BatcherClosedError(RuntimeError):
     hanging forever."""
 
 
+class DrainWhilePausedError(RuntimeError):
+    """``MicroBatcher.drain()`` was called while the worker is parked by
+    ``pause()``: a parked worker can make no progress on queued work, so
+    instead of waiting forever the drain waits a bounded grace period
+    for a concurrent ``resume()`` and then raises this.  Not a request
+    resolution — it signals a caller-side lifecycle bug (drain inside a
+    pause bracket)."""
+
+
+class NoHealthyReplicaError(RuntimeError):
+    """The decision fleet has no healthy (or degraded) replica left to
+    route to — every replica is dead and no standby remains.  A typed
+    request resolution like the other overload errors: the caller's
+    degraded-mode fallback decides what a decision-less tick does."""
+
+
 def resolve_fallback_policy(policy: str) -> str:
     if policy not in FALLBACK_POLICIES:
         raise ValueError(
@@ -83,10 +99,12 @@ def resolve_shed_policy(policy: str) -> str:
 
 
 # the full set a serving client must be prepared to catch: every shed /
-# expired / closed / breaker-open request resolves with one of these
+# expired / closed / breaker-open / no-replica request resolves with one
+# of these
 OVERLOAD_ERRORS = (
     ShedError,
     DeadlineExceeded,
     BatcherClosedError,
     CircuitOpenError,
+    NoHealthyReplicaError,
 )

@@ -20,92 +20,102 @@ BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 class ServeInstruments:
     def __init__(self, registry: Any, *, slo: Any = None,
-                 name: str = "serve"):
+                 name: str = "serve", replica: Optional[str] = None):
         self.registry = registry
         self.slo = slo
         self.name = str(name)
+        # the replica label is OPT-IN: with replica=None every family
+        # keeps its original ("batcher", ...) label names and exposition
+        # is byte-for-byte the single-engine serving path (a registry
+        # rejects re-declaring a family with different label names, so
+        # fleet and non-fleet instruments must not share a registry)
+        self.replica = None if replica is None else str(replica)
+        extra = () if self.replica is None else ("replica",)
+        self._base = {"batcher": self.name}
+        if self.replica is not None:
+            self._base["replica"] = self.replica
         self.requests = registry.counter(
             "gymfx_serve_requests_total",
             "Requests resolved by terminal outcome",
-            labels=("batcher", "outcome"),
+            labels=("batcher", "outcome") + extra,
         )
         self.shed = registry.counter(
             "gymfx_serve_shed_total",
             "Requests shed by admission control, by shed reason",
-            labels=("batcher", "reason"),
+            labels=("batcher", "reason") + extra,
         )
         self.deadline = registry.counter(
             "gymfx_serve_deadline_miss_total",
             "Requests failed past their deadline, by detection phase",
-            labels=("batcher", "phase"),
+            labels=("batcher", "phase") + extra,
         )
         self.breaker_open = registry.counter(
             "gymfx_serve_breaker_open_total",
             "Requests failed fast by an open dispatch circuit breaker",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
         self.failures = registry.counter(
             "gymfx_serve_dispatch_failures_total",
             "Engine dispatches that raised (whole batch failed)",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
         self.dispatches = registry.counter(
             "gymfx_serve_dispatches_total",
             "Engine dispatches completed",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
         self.batch_size = registry.histogram(
             "gymfx_serve_batch_size",
             "Real requests coalesced per engine dispatch",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
             buckets=BATCH_SIZE_BUCKETS,
         )
         self.h_queue = registry.histogram(
             "gymfx_serve_enqueue_to_pickup_seconds",
             "submit() to worker pickup (queue wait)",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
         self.h_window = registry.histogram(
             "gymfx_serve_pickup_to_dispatch_seconds",
             "worker pickup to engine dispatch (batching window)",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
         self.h_dispatch = registry.histogram(
             "gymfx_serve_dispatch_seconds",
             "engine dispatch to response resolution",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
         self.h_latency = registry.histogram(
             "gymfx_serve_latency_seconds",
             "submit() to response resolution (end-to-end)",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
 
     # -- batcher hook points (called from MicroBatcher when injected) --
     def on_shed(self, reason: str, n: int = 1) -> None:
-        self.shed.inc(n, batcher=self.name, reason=reason)
-        self.requests.inc(n, batcher=self.name, outcome="shed")
+        self.shed.inc(n, reason=reason, **self._base)
+        self.requests.inc(n, outcome="shed", **self._base)
         if self.slo is not None:
             for _ in range(n):
                 self.slo.observe("shed")
 
     def on_deadline_miss(self, phase: str, n: int = 1) -> None:
-        self.deadline.inc(n, batcher=self.name, phase=phase)
-        self.requests.inc(n, batcher=self.name, outcome="deadline_miss")
+        self.deadline.inc(n, phase=phase, **self._base)
+        self.requests.inc(n, outcome="deadline_miss", **self._base)
         if self.slo is not None:
             for _ in range(n):
                 self.slo.observe("deadline_miss")
 
     def on_breaker_open(self, n: int = 1) -> None:
-        self.breaker_open.inc(n, batcher=self.name)
-        self.requests.inc(n, batcher=self.name, outcome="breaker_open")
+        self.breaker_open.inc(n, **self._base)
+        self.requests.inc(n, outcome="breaker_open", **self._base)
         if self.slo is not None:
             for _ in range(n):
                 self.slo.observe("breaker_open")
 
     def on_dispatch_failure(self, n: int = 1) -> None:
-        self.failures.inc(1, batcher=self.name)
-        self.requests.inc(n, batcher=self.name, outcome="failed")
+        self.failures.inc(1, **self._base)
+        self.requests.inc(n, outcome="failed", **self._base)
         if self.slo is not None:
             for _ in range(n):
                 self.slo.observe("failed")
@@ -116,20 +126,20 @@ class ServeInstruments:
         rows = list(records)
         if not rows:
             return
-        self.dispatches.inc(1, batcher=self.name)
-        self.batch_size.observe(float(len(rows)), batcher=self.name)
+        self.dispatches.inc(1, **self._base)
+        self.batch_size.observe(float(len(rows)), **self._base)
         for r in rows:
-            self.requests.inc(1, batcher=self.name, outcome="served")
+            self.requests.inc(1, outcome="served", **self._base)
             self.h_queue.observe(
-                max(0.0, r.t_pickup - r.t_enqueue), batcher=self.name
+                max(0.0, r.t_pickup - r.t_enqueue), **self._base
             )
             self.h_window.observe(
-                max(0.0, r.t_dispatch - r.t_pickup), batcher=self.name
+                max(0.0, r.t_dispatch - r.t_pickup), **self._base
             )
             self.h_dispatch.observe(
-                max(0.0, r.t_done - r.t_dispatch), batcher=self.name
+                max(0.0, r.t_done - r.t_dispatch), **self._base
             )
-            self.h_latency.observe(r.latency_s, batcher=self.name)
+            self.h_latency.observe(r.latency_s, **self._base)
             if self.slo is not None:
                 self.slo.observe("served", latency_s=r.latency_s)
 
@@ -138,38 +148,39 @@ class ServeInstruments:
         """Register scrape-time callback gauges over the live batcher
         (queue depth, in-flight count, breaker state) and the rolling
         SLO gauges when an SLO window is attached."""
+        extra = () if self.replica is None else ("replica",)
         depth = self.registry.gauge(
             "gymfx_serve_queue_depth",
             "Requests currently queued (read at scrape time)",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
         # len() on a deque is atomic under the GIL: safe without the
         # batcher lock, and a scrape must never contend with dispatch
         depth.set_function(
-            lambda b=batcher: float(len(b._pending)), batcher=self.name
+            lambda b=batcher: float(len(b._pending)), **self._base
         )
         inflight = self.registry.gauge(
             "gymfx_serve_inflight",
             "Batches currently inside an engine dispatch",
-            labels=("batcher",),
+            labels=("batcher",) + extra,
         )
         inflight.set_function(
-            lambda b=batcher: float(b._inflight), batcher=self.name
+            lambda b=batcher: float(b._inflight), **self._base
         )
         if batcher.max_queue is not None:
             cap = self.registry.gauge(
                 "gymfx_serve_queue_capacity",
                 "Configured admission-control queue bound",
-                labels=("batcher",),
+                labels=("batcher",) + extra,
             )
-            cap.set(float(batcher.max_queue), batcher=self.name)
+            cap.set(float(batcher.max_queue), **self._base)
         engine = getattr(batcher, "engine", None)
         if engine is not None and hasattr(engine, "late_compiles"):
             late = self.registry.gauge(
                 "gymfx_serve_late_compiles_total",
                 "Engine compiles AFTER boot (a warm serving path scrapes "
                 "0 forever; monotonic, read at scrape time)",
-                labels=("batcher",),
+                labels=("batcher",) + extra,
             )
             # read through the batcher at scrape time: the blue/green
             # deployer retargets batcher.engine between micro-batches,
@@ -178,13 +189,19 @@ class ServeInstruments:
                 lambda b=batcher: float(
                     getattr(b.engine, "late_compiles", 0)
                 ),
-                batcher=self.name,
+                **self._base,
             )
         if batcher.breaker is not None:
             from gymfx_tpu.telemetry.registry import register_resilience
 
+            # per-replica breakers need distinct name label values or
+            # the callback gauges of N breakers would collide
+            breaker_name = (
+                self.name if self.replica is None
+                else f"{self.name}:{self.replica}"
+            )
             register_resilience(
-                self.registry, breaker=batcher.breaker, name=self.name
+                self.registry, breaker=batcher.breaker, name=breaker_name
             )
         if self.slo is not None:
             self.slo.register_gauges(self.registry)

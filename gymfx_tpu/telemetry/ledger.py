@@ -50,6 +50,9 @@ EVENT_KINDS = (
     "policy_promote",
     "policy_demote",
     "policy_rollback",
+    "replica_up",
+    "replica_down",
+    "replica_failover",
 )
 
 

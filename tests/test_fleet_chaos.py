@@ -1,0 +1,239 @@
+"""Fleet-chaos harness contract (tools/fleet_chaos.py +
+tools/fleet_report_schema.json).
+
+Two layers: the schema validator must catch every class of report
+drift (missing keys, retyped fields, non-finite numbers, non-object
+maps), and an in-process chaos run over an injected fake-engine fleet
+must hold the acceptance bar — zero dropped requests, a digest-
+verified failover, and carry sessions bitwise identical to the
+unfailed baseline — under the default ``fleet=`` grammar.
+"""
+import importlib.util
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from gymfx_tpu.serve.batcher import MicroBatcher
+from gymfx_tpu.serve.fleet import DecisionFleet, ReplicaSupervisor
+
+from test_serve_fleet import FakeRecurrentEngine
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_chaos():
+    spec = importlib.util.spec_from_file_location(
+        "gymfx_fleet_chaos", REPO / "tools" / "fleet_chaos.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("gymfx_fleet_chaos", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+chaos = _load_chaos()
+
+
+def _good_report():
+    schema = chaos.load_schema()
+    report = {}
+    for key in schema["required"]:
+        if key in schema["integer"]:
+            report[key] = 0
+        elif key in schema["numeric"]:
+            report[key] = 0.0
+        elif key in schema["boolean"]:
+            report[key] = True
+        elif key in schema["object"]:
+            report[key] = {}
+        else:
+            report[key] = "x"
+    report["kind"] = "fleet_report"
+    report["schema_version"] = 1
+    return report
+
+
+# ----------------------------------------------------------------------
+# schema drift
+
+
+def test_validator_accepts_conforming_report():
+    assert chaos.validate_fleet_report(_good_report()) == []
+
+
+def test_validator_flags_every_drift_class():
+    base = _good_report()
+
+    wrong_kind = dict(base, kind="soak_report")
+    assert any(
+        "kind" in p for p in chaos.validate_fleet_report(wrong_kind)
+    )
+
+    for key in ("dropped", "carry_parity", "failover_verified",
+                "survivor_late_compiles", "per_replica_p99_ms",
+                "passed", "wall_s", "fault_profile"):
+        missing = dict(base)
+        del missing[key]
+        assert any(
+            key in p for p in chaos.validate_fleet_report(missing)
+        ), f"missing {key!r} not flagged"
+
+    retyped = dict(base, dropped=0.0)         # float where int pinned
+    assert any("dropped" in p for p in chaos.validate_fleet_report(retyped))
+    retyped = dict(base, dropped=True)        # bool is not an int here
+    assert any("dropped" in p for p in chaos.validate_fleet_report(retyped))
+    retyped = dict(base, carry_parity=1)      # int is not a bool
+    assert any(
+        "carry_parity" in p for p in chaos.validate_fleet_report(retyped)
+    )
+    nonfinite = dict(base, wall_s=float("inf"))
+    assert any("wall_s" in p for p in chaos.validate_fleet_report(nonfinite))
+    not_a_map = dict(base, per_replica_p99_ms=[1.0, 2.0])
+    assert any(
+        "per_replica_p99_ms" in p
+        for p in chaos.validate_fleet_report(not_a_map)
+    )
+
+    assert chaos.validate_fleet_report(["not", "a", "dict"])
+
+
+def test_schema_file_pins_the_acceptance_keys():
+    schema = chaos.load_schema()
+    required = set(schema["required"])
+    # the CI leg's acceptance criteria must stay pinned
+    assert {"dropped", "carry_parity", "failover_verified",
+            "survivor_late_compiles", "failovers", "passed",
+            "fault_profile"} <= required
+    # every typed key is also required (no optional typed fields)
+    for group in ("integer", "numeric", "boolean", "object"):
+        assert set(schema[group]) <= required
+
+
+# ----------------------------------------------------------------------
+# in-process quick chaos over an injected fake fleet
+
+
+class _FakeBundle:
+    def __init__(self, fleet):
+        self.fleet = fleet
+        self.supervisor = ReplicaSupervisor(fleet)
+
+
+def _fake_fleet_factory(config, *, ledger, registry, wrap_engine):
+    """Sub-second stand-in for fleet_from_config: fake recurrent
+    engines, same wrap contract (actives 0..R-1, standbys after)."""
+    replicas = int(config.get("serve_fleet_replicas", 0) or 0)
+    standbys = int(config.get("serve_fleet_standbys", 0) or 0)
+    wrap = wrap_engine or (lambda engine, rid: engine)
+    engines = [
+        wrap(FakeRecurrentEngine(), i) for i in range(replicas)
+    ]
+    spares = [
+        wrap(FakeRecurrentEngine(), replicas + j) for j in range(standbys)
+    ]
+    fleet = DecisionFleet(
+        engines,
+        lambda engine, rid: MicroBatcher(engine, max_batch_wait_ms=0.0),
+        standby_engines=spares,
+        ledger=ledger,
+        registry=registry,
+    )
+    return _FakeBundle(fleet)
+
+
+def test_quick_chaos_holds_the_acceptance_bar(tmp_path):
+    cfg = {"serve_fleet_replicas": 3, "serve_fleet_standbys": 1}
+    report = chaos.run_fleet_chaos(
+        cfg,
+        fault_profile="fleet=kill:1@8;burst=4x6;seed=0",
+        workdir=str(tmp_path),
+        fleet_factory=_fake_fleet_factory,
+        out=str(tmp_path / "fleet_report.json"),
+    )
+    assert chaos.validate_fleet_report(report) == []
+    assert report["passed"] is True
+    assert report["dropped"] == 0
+    assert report["submitted"] == 24
+    assert report["decided"] == 24
+    assert report["failovers"] == 1
+    assert report["failover_verified"] is True
+    assert report["carry_parity"] is True
+    assert report["parity_sessions"] == report["sessions"] == 4
+    assert report["survivor_late_compiles"] == 0
+    assert report["ledger_valid"] is True
+    # the written artifact round-trips through the validator too
+    import json
+
+    on_disk = json.loads((tmp_path / "fleet_report.json").read_text())
+    assert chaos.validate_fleet_report(on_disk) == []
+
+
+def test_chaos_flap_reroutes_without_losing_parity(tmp_path):
+    cfg = {"serve_fleet_replicas": 3, "serve_fleet_standbys": 1}
+    report = chaos.run_fleet_chaos(
+        cfg,
+        fault_profile="fleet=flap:0@4+kill:2@12;burst=4x6;seed=1",
+        workdir=str(tmp_path),
+        fleet_factory=_fake_fleet_factory,
+    )
+    assert report["passed"] is True
+    assert report["dropped"] == 0
+    assert report["reroutes"] > 0     # flap forced typed re-routes
+    assert report["carry_parity"] is True
+
+
+def test_chaos_detects_a_lying_fleet(tmp_path):
+    """A harness that cannot fail is not a harness: break carry parity
+    on purpose (a standby with DIFFERENT weights promoted by the kill)
+    and the report must fail with failover_verified false."""
+
+    def factory(config, *, ledger, registry, wrap_engine):
+        fb = _fake_fleet_factory(
+            config, ledger=ledger, registry=registry,
+            wrap_engine=wrap_engine,
+        )
+        if int(config.get("serve_fleet_replicas", 0) or 0) > 1:
+            # poison the chaos fleet's standby after boot
+            for eng in fb.fleet._standby_engines:
+                eng.params = {"w": np.full(3, 5.0, np.float32)}
+        return fb
+
+    report = chaos.run_fleet_chaos(
+        {"serve_fleet_replicas": 3, "serve_fleet_standbys": 1},
+        fault_profile="fleet=kill:1@4;burst=4x6;seed=0",
+        workdir=str(tmp_path),
+        fleet_factory=factory,
+    )
+    assert report["failovers"] == 1
+    assert report["failover_verified"] is False
+    assert report["passed"] is False
+
+
+def test_stall_event_drives_the_flaky_plan(tmp_path):
+    """A stall event must land in the target replica's FlakyEngine
+    plan (the wrapper contract tools/fleet_chaos.py relies on)."""
+    seen = {}
+
+    def factory(config, *, ledger, registry, wrap_engine):
+        fb = _fake_fleet_factory(
+            config, ledger=ledger, registry=registry,
+            wrap_engine=wrap_engine,
+        )
+        if wrap_engine is not None:
+            seen["fleet"] = fb.fleet
+        return fb
+
+    report = chaos.run_fleet_chaos(
+        {"serve_fleet_replicas": 2, "serve_fleet_standbys": 0},
+        fault_profile="fleet=stall:0@4:1;burst=4x3;seed=0",
+        workdir=str(tmp_path),
+        fleet_factory=factory,
+    )
+    assert report["passed"] is True
+    flaky = seen["fleet"].replica(0).engine
+    # the event landed in replica 0's plan; it is consumed only if the
+    # session hash routed traffic there afterwards (either is correct)
+    tokens = list(flaky.history) + list(flaky._plan)
+    assert any(str(t).startswith("stall:") for t in tokens), tokens

@@ -1,0 +1,693 @@
+"""Decision fleet (gymfx_tpu/serve/fleet.py).
+
+The fleet contract (docs/serving.md, "Decision fleet"): every
+submitted request resolves — with a Decision or one typed overload
+error — across replica deaths; carry-bearing sessions survive failover
+with their decision streams bitwise identical to an unfailed fleet;
+failover promotes only digest-verified standbys and ledgers the whole
+transition; with the fleet knobs unset nothing here is constructed and
+serving stays the single-replica path.
+"""
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from gymfx_tpu.serve.batcher import MicroBatcher
+from gymfx_tpu.serve.engine import Decision
+from gymfx_tpu.serve.fleet import (
+    DecisionFleet,
+    FleetError,
+    ReplicaSupervisor,
+    SessionStateStore,
+    fleet_from_config,
+    params_digest,
+)
+from gymfx_tpu.serve.overload import (
+    NoHealthyReplicaError,
+    ShedError,
+)
+
+OBS_DIM = 6
+
+
+class FakeFleetEngine:
+    """Deterministic per-row engine double: results depend only on the
+    row (and params), never on batch composition — the property real
+    serving gets from ``exact`` batch mode, which is what makes fleet
+    parity provable."""
+
+    recurrent = False
+    obs_dtype = np.float32
+    obs_shape = (OBS_DIM,)
+    buckets = (1, 8)
+    late_compiles = 0
+
+    def __init__(self, params=None):
+        self.params = (
+            {"w": np.ones(3, np.float32)} if params is None else params
+        )
+        self.gate = threading.Event()
+        self.gate.set()
+        self.fail_next = 0
+        self.dispatch_count = 0
+        self.swap_count = 0
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def initial_carry(self):
+        return None
+
+    def swap_weights(self, params, probe=True):
+        self.swap_count += 1
+        self.params = params
+        return self.swap_count
+
+    def _w(self):
+        return float(np.asarray(self.params["w"]).sum())
+
+    def decide_batch(self, obs, carries=None):
+        self.dispatch_count += 1
+        self.gate.wait(timeout=30)
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("injected engine fault")
+        obs = np.asarray(obs)
+        n = len(obs)
+        value = (obs.sum(axis=1) * self._w()).astype(np.float32)
+        return Decision(
+            np.arange(n, dtype=np.int32),
+            value,
+            np.zeros((n, 2), np.float32),
+            (),
+        )
+
+
+class FakeRecurrentEngine(FakeFleetEngine):
+    """Carry = running obs-sum per session: any lost/duplicated/reset
+    carry shows up as a wrong value bit pattern immediately."""
+
+    recurrent = True
+
+    def initial_carry(self):
+        return np.zeros(1, np.float32)
+
+    def decide_batch(self, obs, carries=None):
+        self.dispatch_count += 1
+        self.gate.wait(timeout=30)
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("injected engine fault")
+        obs = np.asarray(obs)
+        n = len(obs)
+        new_carry = (
+            np.asarray(carries, np.float32)
+            + obs.sum(axis=1, keepdims=True).astype(np.float32)
+        )
+        value = (new_carry[:, 0] * self._w()).astype(np.float32)
+        return Decision(
+            np.arange(n, dtype=np.int32),
+            value,
+            np.zeros((n, 2), np.float32),
+            new_carry,
+        )
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (n, OBS_DIM)
+    ).astype(np.float32)
+
+
+def _factory(engine, replica_id):
+    return MicroBatcher(engine, max_batch_wait_ms=0.0)
+
+
+def _fleet(n=3, standbys=1, recurrent=False, **kw):
+    cls = FakeRecurrentEngine if recurrent else FakeFleetEngine
+    engines = [cls() for _ in range(n)]
+    spares = [cls() for _ in range(standbys)]
+    fleet = DecisionFleet(
+        engines, _factory, standby_engines=spares, **kw
+    )
+    return fleet, engines, spares
+
+
+# ----------------------------------------------------------------------
+# routing + resolution
+
+
+def test_stateless_requests_spread_and_all_resolve():
+    fleet, engines, _ = _fleet(n=3)
+    try:
+        futs = [fleet.submit(r) for r in _rows(30, seed=1)]
+        for f in futs:
+            assert isinstance(f.result(timeout=30), Decision)
+        h = fleet.health()
+        assert h["submitted"] == 30 and h["decided"] == 30
+        # round-robin: every replica served a share
+        assert all(
+            h["replicas"][r]["decided"] > 0 for r in h["replicas"]
+        ), h
+    finally:
+        fleet.close()
+
+
+def test_stateless_session_hash_routing_is_sticky():
+    fleet, engines, _ = _fleet(n=3)
+    try:
+        for r in _rows(9, seed=2):
+            fleet.submit(r, session="client-a").result(timeout=30)
+        served = [
+            rep.decided for rep in fleet.active_replicas()
+        ]
+        # one replica took ALL of the session's requests
+        assert sorted(served) == [0, 0, 9], served
+    finally:
+        fleet.close()
+
+
+def test_affine_sessions_match_serial_single_engine_reference():
+    fleet, engines, _ = _fleet(n=3, recurrent=True)
+    reference = FakeRecurrentEngine()
+    sessions, rounds = 4, 6
+    obs = np.random.default_rng(3).standard_normal(
+        (rounds, sessions, OBS_DIM)
+    ).astype(np.float32)
+    got = {s: [] for s in range(sessions)}
+    want = {s: [] for s in range(sessions)}
+    try:
+        for r in range(rounds):
+            futs = {
+                s: fleet.submit(obs[r, s], session=f"s{s}")
+                for s in range(sessions)
+            }
+            for s, f in futs.items():
+                got[s].append(f.result(timeout=30).value.tobytes())
+        carries = {
+            s: reference.initial_carry() for s in range(sessions)
+        }
+        for r in range(rounds):
+            for s in range(sessions):
+                d = reference.decide_batch(
+                    obs[r, s][None], carries[s][None]
+                )
+                carries[s] = np.asarray(d.carry)[0]
+                want[s].append(np.asarray(d.value)[0:1].tobytes())
+        assert got == want
+    finally:
+        fleet.close()
+
+
+def test_fleet_queue_gate_sheds_typed():
+    fleet, engines, _ = _fleet(n=1, standbys=0, max_queue=2)
+    try:
+        engines[0].gate.clear()  # wedge dispatch: the queue backs up
+        f0 = fleet.submit(_rows(1, seed=4)[0])
+        deadline = time.perf_counter() + 5.0
+        while engines[0].dispatch_count == 0:
+            assert time.perf_counter() < deadline
+            time.sleep(0.001)
+        f1 = fleet.submit(_rows(1, seed=5)[0])
+        f2 = fleet.submit(_rows(1, seed=6)[0])
+        with pytest.raises(ShedError) as exc:
+            fleet.submit(_rows(1, seed=7)[0])
+        assert exc.value.reason == "fleet_queue_full"
+        assert fleet.fleet_shed_count == 1
+        engines[0].gate.set()
+        for f in (f0, f1, f2):  # every ADMITTED request still resolves
+            assert isinstance(f.result(timeout=30), Decision)
+    finally:
+        fleet.close()
+
+
+def test_dispatch_fault_reroutes_to_a_survivor():
+    fleet, engines, _ = _fleet(n=2, standbys=0)
+    try:
+        engines[0].fail_next = 5
+        engines[1].fail_next = 0
+        futs = [fleet.submit(r) for r in _rows(8, seed=8)]
+        for f in futs:
+            assert isinstance(f.result(timeout=30), Decision)
+        assert fleet.reroutes > 0
+    finally:
+        fleet.close()
+
+
+def test_no_replica_left_fails_typed_not_hanging():
+    fleet, engines, _ = _fleet(n=1, standbys=0)
+    try:
+        out = fleet.fail_over(0, reason="test")
+        assert out["standby"] is None
+        fut = fleet.submit(_rows(1, seed=9)[0])
+        with pytest.raises(NoHealthyReplicaError):
+            fut.result(timeout=30)
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# failover + session handoff (the tentpole acceptance)
+
+
+def test_kill_mid_stream_keeps_carry_sessions_bitwise_identical(tmp_path):
+    """Satellite 4 pin: a replica killed mid-burst under the parsed
+    ``fleet=`` grammar; affine sessions re-pin to survivors with their
+    carries intact and every per-session decision stream is bitwise
+    identical to an unfailed fleet, with the transition ledgered."""
+    from gymfx_tpu.resilience.faults import parse_fault_profile
+    from gymfx_tpu.telemetry.ledger import (
+        RunLedger,
+        read_ledger,
+        validate_ledger,
+    )
+
+    profile = parse_fault_profile("fleet=kill:1@8;burst=4x6;seed=0")
+    burst = profile["burst"]
+    sessions, rounds = burst["size"], burst["rounds"]
+    obs = np.random.default_rng(10).standard_normal(
+        (rounds, sessions, OBS_DIM)
+    ).astype(np.float32)
+
+    def run(events, ledger=None):
+        fleet, engines, _ = _fleet(
+            n=3, standbys=1, recurrent=True, ledger=ledger
+        )
+        streams = {s: [] for s in range(sessions)}
+        pending = list(events)
+        submitted = 0
+        try:
+            for r in range(rounds):
+                futs = {
+                    s: fleet.submit(obs[r, s], session=f"s{s}")
+                    for s in range(sessions)
+                }
+                submitted += sessions
+                while pending and pending[0]["at"] <= submitted:
+                    ev = pending.pop(0)
+                    fleet.fail_over(ev["replica"], reason="chaos_kill")
+                for s, f in futs.items():
+                    streams[s].append(f.result(timeout=30).value.tobytes())
+            return fleet, streams
+        finally:
+            fleet.close()
+
+    _, baseline = run(())
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    ledger = RunLedger(ledger_path, config={})
+    fleet, chaos = run(profile["fleet"], ledger=ledger)
+    ledger.close()
+
+    assert chaos == baseline  # bitwise: every session, every decision
+    assert fleet.failovers == 1
+    assert fleet.failover_records == [{
+        "replica": 1, "standby": 3, "verified": True,
+        "reason": "chaos_kill",
+    }]
+    assert [r.id for r in fleet.active_replicas()] == [0, 2, 3]
+    assert validate_ledger(ledger_path) == []
+    kinds = [r["kind"] for r in read_ledger(ledger_path)]
+    assert "replica_down" in kinds and "replica_failover" in kinds
+    assert "replica_up" in kinds
+    rows = {r["kind"]: r for r in read_ledger(ledger_path)}
+    assert rows["replica_failover"]["verified"] is True
+    assert rows["replica_failover"]["replica"] == 1
+    assert rows["replica_failover"]["standby"] == 3
+
+
+def test_failover_rejects_standby_with_wrong_weights():
+    fleet, engines, spares = _fleet(n=2, standbys=1)
+    try:
+        # the standby's weights drift AFTER boot (bad hot-swap, bit rot)
+        spares[0].params = {"w": np.full(3, 2.0, np.float32)}
+        out = fleet.fail_over(0, reason="test")
+        assert out["standby"] == 2          # still promoted (capacity)...
+        assert out["verified"] is False     # ...but LOUDLY unverified
+        assert fleet.failover_records[-1]["verified"] is False
+    finally:
+        fleet.close()
+
+
+def test_fail_over_unknown_or_dead_replica_raises():
+    fleet, engines, _ = _fleet(n=2, standbys=0)
+    try:
+        with pytest.raises(FleetError, match="unknown|not active"):
+            fleet.fail_over(7, reason="test")
+        fleet.fail_over(0, reason="test")
+        with pytest.raises(FleetError, match="not active"):
+            fleet.fail_over(0, reason="test")
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# supervisor classification
+
+
+def test_supervisor_fails_over_a_wedged_replica():
+    fleet, engines, _ = _fleet(n=2, standbys=1)
+    sup = ReplicaSupervisor(
+        fleet, probe_timeout_s=0.2, dead_after=1
+    )
+    try:
+        engines[1].gate.clear()  # replica 1 wedges mid-dispatch
+        fleet.submit(_rows(1, seed=11)[0], session=None)
+        states = sup.poll_once()
+        assert states[0] == "healthy"
+        assert states[1] == "dead"
+        assert sup.failovers_triggered == 1
+        assert sorted(r.id for r in fleet.active_replicas()) == [0, 2]
+        # the fleet keeps serving through the survivor + promoted standby
+        assert isinstance(
+            fleet.submit(_rows(1, seed=12)[0]).result(timeout=30),
+            Decision,
+        )
+    finally:
+        engines[1].gate.set()
+        fleet.close()
+
+
+def test_supervisor_degrades_on_late_compiles_and_avoids_new_placements():
+    fleet, engines, _ = _fleet(n=2, standbys=0)
+    sup = ReplicaSupervisor(fleet, probe_timeout_s=5.0, dead_after=2)
+    try:
+        engines[1].late_compiles = 1
+        states = sup.poll_once()
+        assert states == {0: "healthy", 1: "degraded"}
+        before = fleet.replica(1).decided
+        for r in _rows(8, seed=13):
+            fleet.submit(r).result(timeout=30)
+        # degraded: avoided for new placements while a healthy peer exists
+        assert fleet.replica(1).decided == before
+        engines[1].late_compiles = 0
+        assert sup.poll_once() == {0: "healthy", 1: "healthy"}
+    finally:
+        fleet.close()
+
+
+def test_supervisor_thread_runs_and_stops():
+    fleet, engines, _ = _fleet(n=1, standbys=0)
+    sup = ReplicaSupervisor(fleet, interval_s=0.01, probe_timeout_s=5.0)
+    try:
+        sup.start()
+        deadline = time.perf_counter() + 5.0
+        while sup.polls == 0 and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        assert sup.polls > 0
+        sup.stop()
+        polls = sup.polls
+        time.sleep(0.05)
+        assert sup.polls == polls  # really stopped
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------------------
+# session store
+
+
+def test_session_store_lru_eviction_is_counted():
+    store = SessionStateStore(max_sessions=2)
+    store.record_decision("a", np.ones(1))
+    store.record_decision("b", np.ones(1))
+    store.record_decision("c", np.ones(1))  # evicts "a"
+    assert len(store) == 2
+    assert store.evictions == 1
+    assert store.carry("a") is None         # restarted, not stale
+    assert store.carry("b") is not None
+
+
+def test_session_store_owns_its_carry_arrays():
+    store = SessionStateStore()
+    carry = np.zeros(2, np.float32)
+    store.record_decision("s", carry)
+    carry[:] = 99.0  # caller mutates its buffer after the fact
+    assert float(np.asarray(store.carry("s")).sum()) == 0.0
+
+
+def test_unpin_replica_keeps_carries():
+    store = SessionStateStore()
+    store.record_decision("s", np.ones(1, np.float32))
+    store.pin("s", 1)
+    assert store.replica("s") == 1
+    assert store.unpin_replica(1) == ["s"]
+    assert store.replica("s") is None
+    assert store.carry("s") is not None
+
+
+# ----------------------------------------------------------------------
+# fleet-wide deployment (real engines: the promote/rollback surface)
+
+
+def test_promote_swaps_every_lane_and_rollback_is_bitwise(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from gymfx_tpu.serve.engine import InferenceEngine
+    from gymfx_tpu.train.checkpoint import save_checkpoint
+    from gymfx_tpu.train.policies import make_trainer_policy
+
+    pol = make_trainer_policy(
+        "mlp", continuous=False, dtype=jnp.float32,
+        kwargs={"hidden": [16, 16]}, window=4,
+    )
+    example = np.zeros((10,), np.float32)
+    params = pol.init(jax.random.PRNGKey(0), jnp.asarray(example))
+    candidate = pol.init(jax.random.PRNGKey(1), jnp.asarray(example))
+
+    def engine():
+        return InferenceEngine(
+            pol, params, example, buckets=(1, 4), batch_mode="exact"
+        )
+
+    engines = [engine(), engine()]
+    spare = engine()
+    fleet = DecisionFleet(
+        engines, _factory, standby_engines=[spare], seed=5
+    )
+    try:
+        obs = np.random.default_rng(6).standard_normal(
+            (3, 10)
+        ).astype(np.float32)
+        before = [
+            np.asarray(e.decide_batch(obs).value).tobytes()
+            for e in engines
+        ]
+        ckpt = str(tmp_path / "cand")
+        save_checkpoint(ckpt, candidate, step=7)
+
+        res = fleet.promote(ckpt)
+        assert res.generation == 1 and res.replicas == 2
+        assert fleet.rollback_armed
+        assert fleet.weights_digest == params_digest(candidate)
+        after = [
+            np.asarray(e.decide_batch(obs).value).tobytes()
+            for e in engines
+        ]
+        assert all(a != b for a, b in zip(after, before))
+        # the STANDBY swapped too: promoting it later serves new weights
+        assert params_digest(spare.params) == params_digest(candidate)
+
+        rb = fleet.rollback()
+        assert rb.verified is True
+        assert fleet.generation == 0
+        restored = [
+            np.asarray(e.decide_batch(obs).value).tobytes()
+            for e in engines
+        ]
+        assert restored == before
+        assert all(e.late_compiles == 0 for e in engines + [spare])
+        with pytest.raises(FleetError, match="rollback"):
+            fleet.rollback()  # disarmed after use
+    finally:
+        fleet.close()
+
+
+def test_boot_rejects_mismatched_weight_identities():
+    a, b = FakeFleetEngine(), FakeFleetEngine(
+        params={"w": np.full(3, 2.0, np.float32)}
+    )
+    with pytest.raises(FleetError, match="weight"):
+        DecisionFleet([a, b], _factory)
+
+
+# ----------------------------------------------------------------------
+# metrics: per-replica labels + fleet gauges through /metrics
+
+
+def test_fleet_metrics_scrape_with_replica_labels():
+    from gymfx_tpu.telemetry import MetricsRegistry
+    from gymfx_tpu.telemetry.http import TelemetryServer, scrape
+    from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+    registry = MetricsRegistry()
+
+    def factory(engine, replica_id):
+        inst = ServeInstruments(
+            registry, name="serve", replica=str(replica_id)
+        )
+        mb = MicroBatcher(
+            engine, max_batch_wait_ms=0.0, instruments=inst
+        )
+        inst.bind_batcher(mb)
+        return mb
+
+    engines = [FakeFleetEngine() for _ in range(3)]
+    fleet = DecisionFleet(
+        engines, factory,
+        standby_engines=[FakeFleetEngine()],
+        registry=registry,
+    )
+    try:
+        for r in _rows(6, seed=14):
+            fleet.submit(r).result(timeout=30)
+        fleet.fail_over(0, reason="test")
+        with TelemetryServer(registry, port=0) as srv:
+            text = scrape(srv.url + "/metrics")
+        # per-replica serve families carry the replica label
+        assert 'gymfx_serve_requests_total{batcher="serve"' in text
+        assert 'replica="1"' in text
+        # fleet-level families scrape live state
+        assert 'gymfx_fleet_replicas{state="healthy"} 3' in text
+        assert 'gymfx_fleet_replicas{state="dead"} 1' in text
+        assert "gymfx_fleet_failovers_total 1" in text
+    finally:
+        fleet.close()
+
+
+def test_instruments_without_replica_keep_original_exposition():
+    """The single-replica pin: replica=None must not grow a label."""
+    from gymfx_tpu.telemetry import MetricsRegistry
+    from gymfx_tpu.telemetry import prometheus
+    from gymfx_tpu.telemetry.instruments import ServeInstruments
+
+    registry = MetricsRegistry()
+    inst = ServeInstruments(registry, name="e2e")
+    inst.on_shed("queue_full")
+    text = prometheus.render(registry)
+    assert ('gymfx_serve_requests_total{batcher="e2e",outcome="shed"} 1'
+            in text)
+    assert "replica" not in text
+
+
+# ----------------------------------------------------------------------
+# config surface: fleet off by default, grammar pins
+
+
+def test_fleet_knobs_unset_keep_single_replica_serving():
+    from gymfx_tpu.config.defaults import DEFAULT_VALUES
+    from gymfx_tpu.serve.config import fleet_config_from
+
+    fcfg = fleet_config_from(DEFAULT_VALUES)
+    assert fcfg.replicas == 0
+    with pytest.raises(ValueError, match="serve_fleet_replicas"):
+        fleet_from_config(dict(DEFAULT_VALUES))
+
+
+def test_fleet_from_config_builds_wired_bundle_and_controller_uses_it():
+    """Real-engine construction path: one env/feed, shared boot weight
+    identity, per-replica instruments, and controller_from_config
+    routing to the fleet when the knob is set."""
+    from gymfx_tpu.config.defaults import DEFAULT_VALUES
+    from gymfx_tpu.deploy.controller import controller_from_config
+    from gymfx_tpu.serve.fleet import FleetBundle
+    from gymfx_tpu.telemetry import MetricsRegistry
+    from gymfx_tpu.telemetry import prometheus
+
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update({
+        "input_file": "tests/data/eurusd_uptrend.csv",
+        "window_size": 8,
+        "num_envs": 8,
+        "policy_kwargs": {"hidden": [16, 16]},
+        "seed": 1,
+        "serve_buckets": [1, 4],
+        "serve_batch_mode": "exact",
+        "serve_max_batch_wait_ms": 0.5,
+        "serve_fleet_replicas": 2,
+        "serve_fleet_standbys": 1,
+        "quiet_mode": True,
+    })
+    registry = MetricsRegistry()
+    controller, fb = controller_from_config(cfg, registry=registry)
+    assert isinstance(fb, FleetBundle)
+    assert controller.deployer is fb.fleet     # the controller drives it
+    assert fb.deployer is fb.fleet and fb.batcher is fb.fleet
+    fleet = fb.fleet
+    try:
+        assert len(fleet.active_replicas()) == 2
+        assert fleet.standby_count() == 1
+        digests = {
+            params_digest(r.engine.params)
+            for r in fleet.active_replicas()
+        }
+        assert digests == {fleet.weights_digest}
+        obs = np.random.default_rng(2).standard_normal(
+            (4, *fleet.engine.obs_shape)
+        ).astype(fleet.engine.obs_dtype)
+        for row in obs:
+            assert fleet.submit(row).result(timeout=30) is not None
+        text = prometheus.render(registry)
+        assert 'replica="0"' in text and 'replica="1"' in text
+        assert 'gymfx_fleet_replicas{state="healthy"} 2' in text
+        assert all(
+            r.engine.late_compiles == 0 for r in fleet.active_replicas()
+        )
+    finally:
+        fleet.close()
+
+
+def test_fleet_config_from_validates_ranges():
+    from gymfx_tpu.config.defaults import DEFAULT_VALUES
+    from gymfx_tpu.serve.config import fleet_config_from
+
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update(serve_fleet_replicas=3, serve_fleet_standbys=2,
+               serve_fleet_max_queue=64, serve_fleet_retry_limit=4)
+    fcfg = fleet_config_from(cfg)
+    assert (fcfg.replicas, fcfg.standbys) == (3, 2)
+    assert fcfg.max_queue == 64 and fcfg.retry_limit == 4
+    # falsy values fall back to defaults (the "unset" spelling)...
+    assert fleet_config_from(
+        dict(cfg, serve_fleet_probe_rows=0)
+    ).probe_rows == 1
+    # ...but out-of-range values raise
+    for key, bad, match in (
+        ("serve_fleet_replicas", -1, "serve_fleet_replicas"),
+        ("serve_fleet_standbys", -2, "serve_fleet_standbys"),
+        ("serve_fleet_probe_rows", -1, "probe_rows"),
+        ("serve_fleet_dead_after", -3, "dead_after"),
+        ("serve_fleet_probe_interval_s", -0.5, "probe_interval"),
+    ):
+        broken = dict(cfg)
+        broken[key] = bad
+        with pytest.raises(ValueError, match=match):
+            fleet_config_from(broken)
+
+
+def test_fleet_fault_grammar_parses_and_rejects():
+    from gymfx_tpu.resilience.faults import parse_fault_profile
+
+    profile = parse_fault_profile(
+        "fleet=kill:1@8+stall:0@4:250+flap:2@6;seed=3"
+    )
+    assert profile["fleet"] == [
+        {"action": "stall", "replica": 0, "at": 4, "ms": 250.0},
+        {"action": "flap", "replica": 2, "at": 6, "ms": None},
+        {"action": "kill", "replica": 1, "at": 8, "ms": None},
+    ]
+    for bad in (
+        "fleet=reboot:1@8",      # unknown action
+        "fleet=kill:1",          # missing @decision
+        "fleet=kill:1@8:250",    # ms tail on a non-stall action
+        "fleet=stall:0@4:0",     # non-positive stall ms
+        "fleet=kill:-1@8",       # negative replica
+    ):
+        with pytest.raises(ValueError):
+            parse_fault_profile(bad)

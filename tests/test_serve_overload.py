@@ -327,6 +327,38 @@ def test_pause_timeout_rolls_back_and_queue_keeps_moving():
     mb.close()
 
 
+def test_drain_while_paused_raises_typed_instead_of_hanging():
+    """Regression: drain() on a pause()d batcher used to wait on a
+    parked worker until the caller's full timeout — a lifecycle bug
+    (drain during a deploy flip) surfaced as a silent hang.  It now
+    raises DrainWhilePausedError once the grace window expires."""
+    from gymfx_tpu.serve.overload import DrainWhilePausedError
+
+    eng = FakeEngine()
+    mb = MicroBatcher(eng, max_batch_wait_ms=0.0)
+    assert mb.pause(timeout=30) is True
+    mb.paused_drain_grace_s = 0.05
+    fut = mb.submit(_rows(1, seed=15)[0])  # queued behind the pause
+    t0 = time.perf_counter()
+    with pytest.raises(DrainWhilePausedError):
+        mb.drain(timeout=30)
+    assert time.perf_counter() - t0 < 5.0  # grace, not the caller timeout
+    assert not fut.done()                  # the queued request is intact
+    mb.resume()
+    assert mb.drain(timeout=30) is True    # resumed: drain flushes
+    assert isinstance(fut.result(timeout=1), Decision)
+    mb.close()
+
+
+def test_drain_while_paused_but_empty_succeeds():
+    eng = FakeEngine()
+    mb = MicroBatcher(eng, max_batch_wait_ms=0.0)
+    assert mb.pause(timeout=30) is True
+    mb.paused_drain_grace_s = 0.05
+    assert mb.drain(timeout=30) is True  # nothing queued: nothing to flush
+    mb.close()
+
+
 def test_pause_is_idempotent_and_closed_batcher_raises():
     eng = FakeEngine()
     mb = MicroBatcher(eng, max_batch_wait_ms=0.0)
@@ -364,6 +396,35 @@ def test_flaky_engine_plan_tokens_and_delegation():
     # attribute delegation: drops into MicroBatcher(engine=...) unchanged
     assert flaky.buckets == eng.buckets
     assert flaky.recurrent is False
+
+
+def test_flaky_engine_delegates_attribute_writes_to_inner():
+    """Regression: attribute SETS used to land on the wrapper, so
+    callers configuring the engine through the FlakyEngine (deploy
+    hooks, watchers) silently configured nothing."""
+    eng = FakeEngine()
+    flaky = FlakyEngine(eng)
+    flaky.fail_next = 3              # inner HAS it: the write passes through
+    assert eng.fail_next == 3
+    assert flaky.fail_next == 3
+    flaky.on_compile = "callback"    # inner lacks it: stays on the wrapper
+    assert not hasattr(eng, "on_compile")
+    assert flaky.on_compile == "callback"
+    flaky.dispatch_calls = 5         # wrapper-own counters stay wrapper-own
+    assert flaky.dispatch_calls == 5
+    assert not hasattr(eng, "dispatch_calls")
+
+
+def test_flaky_engine_push_faults_extends_the_live_plan():
+    eng = FakeEngine()
+    flaky = FlakyEngine(eng, plan=["ok"], sleep=lambda s: None)
+    rows = _rows(1, seed=16)
+    assert isinstance(flaky.decide_batch(rows), Decision)
+    flaky.push_faults("exc", "stall:30")
+    with pytest.raises(InjectedDispatchError):
+        flaky.decide_batch(rows)
+    assert isinstance(flaky.decide_batch(rows), Decision)  # stall completes
+    assert flaky.faults_injected == 2
 
 
 def test_flaky_engine_from_profile_inert_is_identity():

@@ -1,0 +1,435 @@
+#!/usr/bin/env python3
+"""Fleet-scale chaos: burst decision traffic through an N-replica
+DecisionFleet while the ``fleet=`` fault grammar (docs/resilience.md)
+kills, stalls and flaps replicas mid-burst — then prove nothing was
+lost.
+
+Two passes run against the SAME seeded per-session observation streams:
+a baseline fleet with no faults, and a chaos fleet whose engines are
+FlakyEngine-wrapped and whose ``fleet=`` events fire at their scripted
+global decision indices (``kill:1@8`` fails replica 1 over while round
+traffic is in flight).  Because serving runs the ladder in ``exact``
+batch mode and failover re-pins sessions with their host-side carry
+intact, every session's decision stream must come back bitwise
+identical to the unfailed baseline — that parity, zero dropped
+requests, zero survivor late-compiles, and a digest-verified failover
+are the report's pass contract.
+
+The run emits a schema-pinned ``fleet_report.json``
+(tools/fleet_report_schema.json):
+
+    python tools/fleet_chaos.py --quick
+    python tools/fleet_chaos.py --quick \\
+        --fault_profile 'fleet=kill:1@8+stall:0@4;burst=4x6;seed=0'
+
+``validate_fleet_report`` is imported by tests/test_fleet_chaos.py and
+the tools/run_tests.sh fleet-chaos leg, keeping the schema and this
+emitter from drifting apart silently.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+from concurrent.futures import TimeoutError as FuturesTimeout
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SCHEMA_PATH = Path(__file__).resolve().parent / "fleet_report_schema.json"
+
+DEFAULT_FAULT_PROFILE = "fleet=kill:1@8;burst=4x6;seed=0"
+
+# the sub-minute CI shape: a tiny recurrent policy (carry handoff is
+# the point), a two-bucket exact-mode ladder, three replicas + one
+# warm standby
+QUICK_CONFIG = {
+    "input_file": "tests/data/eurusd_uptrend.csv",
+    "window_size": 8,
+    "num_envs": 8,
+    "policy": "lstm",
+    "policy_kwargs": {"hidden": 8},
+    "seed": 1,
+    "serve_buckets": [1, 4],
+    "serve_batch_mode": "exact",
+    "serve_max_batch_wait_ms": 0.5,
+    "serve_fleet_replicas": 3,
+    "serve_fleet_standbys": 1,
+    "quiet_mode": True,
+}
+
+
+def load_schema() -> Dict[str, Any]:
+    with open(SCHEMA_PATH, encoding="utf-8") as fh:
+        schema = json.load(fh)
+    schema.pop("_comment", None)
+    return schema
+
+
+def validate_fleet_report(report: Dict[str, Any],
+                          schema: Optional[Dict[str, Any]] = None,
+                          ) -> List[str]:
+    """Return a list of contract violations (empty = report conforms)."""
+    if schema is None:
+        schema = load_schema()
+    if not isinstance(report, dict):
+        return [f"report is not a JSON object: {type(report).__name__}"]
+    problems: List[str] = []
+    if report.get("kind") != schema["kind"]:
+        problems.append(
+            f"kind must be {schema['kind']!r}, got {report.get('kind')!r}"
+        )
+    for key in schema["required"]:
+        if key not in report:
+            problems.append(f"missing required key {key!r}")
+    for key in schema["integer"]:
+        if key in report and not (
+            isinstance(report[key], int) and not isinstance(report[key], bool)
+        ):
+            problems.append(
+                f"key {key!r} must be an integer, got {report[key]!r}"
+            )
+    for key in schema["numeric"]:
+        if key in report and not (
+            isinstance(report[key], (int, float))
+            and not isinstance(report[key], bool)
+            and math.isfinite(float(report[key]))
+        ):
+            problems.append(
+                f"key {key!r} must be a finite number, got {report[key]!r}"
+            )
+    for key in schema["boolean"]:
+        if key in report and not isinstance(report[key], bool):
+            problems.append(
+                f"key {key!r} must be a boolean, got {report[key]!r}"
+            )
+    for key in schema["object"]:
+        if key in report and not isinstance(report[key], dict):
+            problems.append(
+                f"key {key!r} must be a JSON object, got {report[key]!r}"
+            )
+    return problems
+
+
+def _fire_event(fleet: Any, wrappers: Dict[int, Any],
+                ev: Dict[str, Any]) -> None:
+    """Apply one parsed ``fleet=`` event to the live fleet.  ``kill``
+    drives the real failover path; ``stall``/``flap`` push dispatch
+    faults into the target replica's FlakyEngine plan."""
+    from gymfx_tpu.serve.fleet import FleetError
+
+    rid = int(ev["replica"])
+    action = ev["action"]
+    if action == "kill":
+        try:
+            fleet.fail_over(rid, reason="chaos_kill")
+        except FleetError:
+            pass  # scripted kill of an already-dead replica is inert
+    elif action == "stall":
+        wrapper = wrappers.get(rid)
+        if wrapper is not None:
+            wrapper.push_faults(f"stall:{ev.get('ms') or 250.0}")
+    elif action == "flap":
+        wrapper = wrappers.get(rid)
+        if wrapper is not None:
+            # a short exception burst, then recovery — the re-route
+            # path must absorb it without losing a decision
+            wrapper.push_faults("exc", "exc")
+
+
+def _burst_rounds(
+    fleet: Any,
+    obs_all: Any,
+    *,
+    events: Tuple[Dict[str, Any], ...] = (),
+    wrappers: Optional[Dict[int, Any]] = None,
+    timeout_s: float = 60.0,
+) -> Tuple[Dict[str, int], Dict[str, List[bytes]]]:
+    """Drive ``rounds`` bursts of one decision per session through the
+    fleet (sessions submit serially: decision r+1 only after r
+    resolved).  ``events`` fire once their ``at`` index is covered by
+    the submitted count — AFTER the round's submits, so a kill lands
+    with that round's requests in flight.  Every future is accounted:
+    decision, typed shed, typed error, or (never, by contract)
+    dropped."""
+    from gymfx_tpu.serve.deploy import decision_bytes
+    from gymfx_tpu.serve.overload import ShedError
+
+    rounds, sessions = int(obs_all.shape[0]), int(obs_all.shape[1])
+    counts = {"submitted": 0, "decided": 0, "shed": 0,
+              "typed_errors": 0, "dropped": 0}
+    streams: Dict[str, List[bytes]] = {
+        f"s{s:03d}": [] for s in range(sessions)
+    }
+    pending = sorted(events, key=lambda ev: ev["at"])
+    submitted = 0
+    for r in range(rounds):
+        futures: List[Tuple[str, Any]] = []
+        for s in range(sessions):
+            name = f"s{s:03d}"
+            counts["submitted"] += 1
+            try:
+                fut = fleet.submit(obs_all[r, s], session=name)
+            except ShedError:
+                counts["shed"] += 1
+                fut = None
+            except Exception:
+                counts["typed_errors"] += 1
+                fut = None
+            futures.append((name, fut))
+        submitted += sessions
+        while pending and pending[0]["at"] <= submitted:
+            _fire_event(fleet, wrappers or {}, pending.pop(0))
+        for name, fut in futures:
+            if fut is None:
+                continue
+            try:
+                decision = fut.result(timeout_s)
+            except FuturesTimeout:
+                counts["dropped"] += 1  # never resolved — the violation
+            except ShedError:
+                counts["shed"] += 1
+            except Exception:
+                counts["typed_errors"] += 1
+            else:
+                counts["decided"] += 1
+                streams[name].append(decision_bytes(decision))
+    return counts, streams
+
+
+def _default_fleet_factory(config: Dict[str, Any], *, ledger: Any,
+                           registry: Any, wrap_engine: Any) -> Any:
+    from gymfx_tpu.serve.fleet import fleet_from_config
+
+    return fleet_from_config(
+        config, ledger=ledger, registry=registry, wrap_engine=wrap_engine
+    )
+
+
+def run_fleet_chaos(
+    config: Dict[str, Any],
+    *,
+    fault_profile: str = DEFAULT_FAULT_PROFILE,
+    workdir: str,
+    fleet_factory: Optional[Callable[..., Any]] = None,
+    out: Optional[str] = None,
+    timeout_s: float = 60.0,
+) -> Dict[str, Any]:
+    """Run baseline + chaos passes and return (and optionally write)
+    the report.
+
+    ``fleet_factory(config, ledger=, registry=, wrap_engine=)`` must
+    return a FleetBundle-shaped object; tests inject sub-second
+    fake-engine fleets through it (it is called twice — once with the
+    baseline single-replica config, once with the chaos config)."""
+    import numpy as np
+
+    from gymfx_tpu.resilience.faults import FlakyEngine, parse_fault_profile
+    from gymfx_tpu.telemetry import MetricsRegistry
+    from gymfx_tpu.telemetry.ledger import (
+        RunLedger,
+        read_ledger,
+        validate_ledger,
+    )
+
+    factory = fleet_factory or _default_fleet_factory
+    t_start = time.perf_counter()
+    workdir_p = Path(workdir)
+    workdir_p.mkdir(parents=True, exist_ok=True)
+    profile = parse_fault_profile(fault_profile)
+    burst = profile.get("burst") or {"size": 4, "rounds": 6}
+    sessions, rounds = int(burst["size"]), int(burst["rounds"])
+    events = tuple(profile.get("fleet") or ())
+
+    cfg = dict(config)
+    replicas = int(cfg.get("serve_fleet_replicas", 0) or 0)
+    standbys = int(cfg.get("serve_fleet_standbys", 0) or 0)
+
+    # -- baseline: a single unfailed replica serving the same streams.
+    # exact batch mode makes per-row decisions independent of batch
+    # composition and replica count, so this IS the unfailed fleet's
+    # decision stream at 1/N the boot cost.
+    base_cfg = dict(cfg)
+    base_cfg.update({"serve_fleet_replicas": 1, "serve_fleet_standbys": 0})
+    fb = factory(base_cfg, ledger=None, registry=None, wrap_engine=None)
+    obs_all = None
+    try:
+        engine = fb.fleet.engine
+        rng = np.random.default_rng(int(profile.get("seed", 0)))
+        obs_all = rng.standard_normal(
+            (rounds, sessions, *engine.obs_shape)
+        ).astype(engine.obs_dtype)
+        base_counts, base_streams = _burst_rounds(
+            fb.fleet, obs_all, timeout_s=timeout_s
+        )
+    finally:
+        fb.fleet.close()
+    if base_counts["decided"] != rounds * sessions:
+        raise RuntimeError(
+            f"baseline pass must decide every request, got "
+            f"{base_counts['decided']}/{rounds * sessions}"
+        )
+
+    # -- chaos: the full fleet, FlakyEngine-wrapped, events armed
+    wrappers: Dict[int, Any] = {}
+
+    def wrap(engine: Any, replica_id: int) -> Any:
+        flaky = FlakyEngine(engine)
+        wrappers[replica_id] = flaky
+        return flaky
+
+    registry = MetricsRegistry()
+    ledger_path = str(workdir_p / "fleet_ledger.jsonl")
+    ledger = RunLedger(ledger_path, config=cfg)
+    fb = factory(cfg, ledger=ledger, registry=registry, wrap_engine=wrap)
+    fleet = fb.fleet
+    try:
+        counts, streams = _burst_rounds(
+            fleet, obs_all, events=events, wrappers=wrappers,
+            timeout_s=timeout_s,
+        )
+        survivors = fleet.active_replicas()
+        survivor_late = sum(
+            int(getattr(r.engine, "late_compiles", 0)) for r in survivors
+        )
+        per_replica_p99: Dict[str, float] = {}
+        for rep in survivors + fleet.dead_replicas():
+            recs = rep.batcher.records
+            per_replica_p99[str(rep.id)] = (
+                float(np.percentile(
+                    np.asarray([r.latency_s for r in recs]), 99.0
+                ) * 1e3)
+                if recs else 0.0
+            )
+        failovers = int(fleet.failovers)
+        failover_verified = all(
+            rec["verified"] for rec in fleet.failover_records
+        )
+        reroutes = int(fleet.reroutes)
+    finally:
+        fleet.close()
+        ledger.close()
+
+    full = rounds  # decisions per session when nothing was lost
+    parity_sessions = sum(
+        1 for name, stream in streams.items()
+        if len(stream) == full and stream == base_streams[name]
+    )
+    carry_parity = parity_sessions == sessions
+
+    ledger_problems = validate_ledger(ledger_path)
+    n_rows = len(read_ledger(ledger_path))
+
+    report = {
+        "kind": "fleet_report",
+        "schema_version": 1,
+        "fault_profile": str(fault_profile),
+        "replicas": replicas,
+        "standbys": standbys,
+        "sessions": sessions,
+        "rounds": rounds,
+        "submitted": int(counts["submitted"]),
+        "decided": int(counts["decided"]),
+        "shed": int(counts["shed"]),
+        "typed_errors": int(counts["typed_errors"]),
+        "dropped": int(counts["dropped"]),
+        "reroutes": reroutes,
+        "failovers": failovers,
+        "failover_verified": bool(failover_verified),
+        "survivor_late_compiles": int(survivor_late),
+        "carry_parity": bool(carry_parity),
+        "parity_sessions": int(parity_sessions),
+        "per_replica_p99_ms": per_replica_p99,
+        "ledger_rows": int(n_rows),
+        "ledger_valid": not ledger_problems,
+        "wall_s": float(time.perf_counter() - t_start),
+        "passed": bool(
+            counts["dropped"] == 0
+            and carry_parity
+            and failover_verified
+            and survivor_late == 0
+            and not ledger_problems
+        ),
+    }
+    if out:
+        Path(out).write_text(
+            json.dumps(report, indent=2, sort_keys=True) + "\n",
+            encoding="utf-8",
+        )
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--fault_profile", type=str, default=DEFAULT_FAULT_PROFILE,
+        help="fault grammar (resilience/faults.py); fleet=... events "
+             "fire at global decision indices, burst=NxK shapes the "
+             "rounds (N sessions, K decisions each)",
+    )
+    ap.add_argument("--quick", action="store_true",
+                    help=f"CI shape: {QUICK_CONFIG}")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="override serve_fleet_replicas")
+    ap.add_argument("--workdir", type=str, default=None,
+                    help="ledger scratch dir (default: a fresh temp dir)")
+    ap.add_argument("--out", type=str, default="fleet_report.json",
+                    help="report path (always printed to stdout)")
+    args = ap.parse_args(argv)
+
+    from gymfx_tpu.config.defaults import DEFAULT_VALUES
+
+    config = dict(DEFAULT_VALUES)
+    if args.quick:
+        config.update(QUICK_CONFIG)
+    if args.replicas:
+        config["serve_fleet_replicas"] = int(args.replicas)
+    if int(config.get("serve_fleet_replicas", 0) or 0) < 1:
+        # the default config keeps single-replica serving; a chaos run
+        # without an explicit fleet shape gets the CI one
+        config.update({"serve_fleet_replicas": 3, "serve_fleet_standbys": 1})
+    if not config.get("input_file"):
+        config["input_file"] = QUICK_CONFIG["input_file"]
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        workdir = args.workdir or tmp
+        report = run_fleet_chaos(
+            config,
+            fault_profile=args.fault_profile,
+            workdir=workdir,
+            out=args.out,
+        )
+    problems = validate_fleet_report(report)
+    if problems:  # emitter bug — fail loudly, never ship a bad report
+        for p in problems:
+            print(f"FLEET REPORT SCHEMA VIOLATION: {p}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if not report["passed"]:
+        print(
+            f"fleet chaos FAILED: dropped={report['dropped']} "
+            f"carry_parity={report['carry_parity']} "
+            f"failover_verified={report['failover_verified']} "
+            f"survivor_late_compiles={report['survivor_late_compiles']}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"fleet chaos OK ({report['decided']}/{report['submitted']} "
+        f"decisions, {report['failovers']} failovers, "
+        f"{report['reroutes']} re-routes, "
+        f"{report['parity_sessions']}/{report['sessions']} sessions "
+        f"bitwise-identical to the unfailed baseline)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,11 @@
 # fails a synthetic kernel regression), a soak-quick leg (two
 # retrain->gate->swap->serve cycles under the fault grammar: schema-
 # valid soak report, zero dropped decisions, zero late compiles,
-# bitwise-verified rollback — docs/resilience.md), then a telemetry
+# bitwise-verified rollback — docs/resilience.md), a fleet-chaos quick
+# leg (three-replica decision fleet loses a replica to a scripted kill
+# mid-burst: schema-valid fleet report, zero dropped requests, digest-
+# verified failover, carry sessions bitwise-identical to the unfailed
+# baseline — docs/serving.md "Decision fleet"), then a telemetry
 # smoke
 # (ephemeral /metrics endpoint, one scrape, assert non-empty —
 # docs/observability.md) and a per-run summary row appended to
@@ -253,6 +257,51 @@ with tempfile.TemporaryDirectory() as d:
 EOF
 echo "soak-quick (2 cycles, fault grammar): rc=$soak_rc"
 
+# fleet-chaos quick leg: a three-replica decision fleet loses replica 1
+# to a scripted kill mid-burst and must emit a schema-valid fleet
+# report with zero dropped requests, a digest-verified failover, and
+# every session's decision stream bitwise identical to the unfailed
+# baseline (docs/serving.md, "Decision fleet")
+fleet_rc=0
+env JAX_PLATFORMS=cpu python - <<'EOF' || fleet_rc=$?
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, "tools")
+from fleet_chaos import validate_fleet_report  # noqa: E402
+
+with tempfile.TemporaryDirectory() as d:
+    out = Path(d) / "fleet_report.json"
+    run = subprocess.run(
+        [sys.executable, "tools/fleet_chaos.py", "--quick",
+         "--workdir", d, "--out", str(out)],
+        capture_output=True, text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if run.returncode != 0 or not out.exists():
+        print("fleet chaos CLI failed:",
+              run.stdout[-2000:], run.stderr[-2000:])
+        sys.exit(run.returncode or 1)
+    report = json.loads(out.read_text(encoding="utf-8"))
+    problems = validate_fleet_report(report)
+    if problems:
+        print("FLEET REPORT SCHEMA VIOLATIONS:", *problems, sep="\n  ")
+        sys.exit(1)
+    assert report["passed"] is True, report
+    assert report["dropped"] == 0, report
+    assert report["failovers"] >= 1, report
+    assert report["failover_verified"] is True, report
+    assert report["carry_parity"] is True, report
+    print(f"fleet-chaos quick OK ({report['decided']} decisions, "
+          f"{report['failovers']} failovers, "
+          f"{report['parity_sessions']}/{report['sessions']} sessions "
+          f"bitwise-identical)")
+EOF
+echo "fleet-chaos quick (3 replicas, scripted kill): rc=$fleet_rc"
+
 # telemetry smoke + PROGRESS row (registry/http/sink are jax-free:
 # this is sub-second and runs even when the suite failed, so the row
 # records the failure too)
@@ -312,5 +361,8 @@ if [ "$profile_rc" -ne 0 ]; then
 fi
 if [ "$soak_rc" -ne 0 ]; then
     exit "$soak_rc"
+fi
+if [ "$fleet_rc" -ne 0 ]; then
+    exit "$fleet_rc"
 fi
 exit "$smoke_rc"
