@@ -197,6 +197,79 @@ def scengen_main(args) -> None:
     )
 
 
+def _stream_probe(data_compress: str, n_bars: int) -> dict:
+    """Billion-bar data path probe (docs/performance.md): stream a
+    tick-snapped generated tape through the compressed BarStreamer and
+    report decode throughput plus the resident-bars win over the
+    uncompressed double buffer at the SAME HBM budget.
+
+    All four headline keys are null with ``--data_compress off`` — the
+    probe only runs when the compressed path is requested, so the
+    default bench row is byte-identical to previous rounds.
+    """
+    keys = (
+        "stream_bars_per_sec", "data_compression_ratio",
+        "resident_bars", "resident_bars_uncompressed",
+    )
+    if data_compress == "off":
+        return {k: None for k in keys}
+    import time
+
+    import jax
+
+    from gymfx_tpu.config import DEFAULT_VALUES
+    from gymfx_tpu.data.feed import BarStreamer, market_data_nbytes
+    from gymfx_tpu.scengen.feed import ScenGenDataset
+
+    window = 32
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update(
+        feed="scengen", scengen_preset="regime_mix",
+        scengen_bars=int(n_bars), scengen_seed=0,
+        # generated prices snapped onto the LOB int-tick grid in f64,
+        # BEFORE the f32 cast — the int16 tick-delta wire format's
+        # on-grid requirement (scengen/feed.py)
+        scengen_snap_to_tick=True, window_size=window,
+        # a DST-free window (between the March and November US shifts):
+        # NY-calendar columns are weekly-periodic inside it, so they
+        # compress to one-week lookup tables; a tape crossing a DST
+        # shift keeps correctness by falling back to q16 deltas for
+        # those columns at ~0.7x the ratio (DIVERGENCES.md)
+        scengen_start="2024-03-17",
+    )
+    tick = float(cfg.get("lob_tick_size") or 1e-5)
+    host = ScenGenDataset(cfg).build_market_data(
+        window_size=window, device=False
+    )
+    # budget = 1/8 of the decoded tape: both modes must stream (the
+    # compressed ring must not swallow the whole tape, or the resident
+    # comparison degenerates to "everything fits")
+    budget_mb = market_data_nbytes(host) / 8 / 2**20
+    bs = BarStreamer(
+        host, window_size=window, budget_mb=budget_mb,
+        compress=data_compress, tick_size=tick,
+    )
+    bs_off = BarStreamer(
+        host, window_size=window, budget_mb=budget_mb,
+        compress="off", tick_size=tick,
+    )
+    jax.block_until_ready(bs._device_shard(0).close)  # compile + warmup
+    t0 = time.perf_counter()
+    shard = None
+    for k in range(bs.num_shards):
+        shard = bs._device_shard(k)
+    jax.block_until_ready(shard.close)
+    dt = time.perf_counter() - t0
+    return {
+        "stream_bars_per_sec": round(bs.num_shards * bs.shard_bars / dt, 1),
+        "data_compression_ratio": round(bs.compression_ratio, 3),
+        "resident_bars": int(bs.resident_bars),
+        "resident_bars_uncompressed": int(bs_off.resident_bars),
+        "stream_hbm_budget_mb": round(budget_mb, 3),
+        "stream_tape_bars": int(n_bars),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n_envs", type=int, default=8192)
@@ -217,6 +290,23 @@ def main() -> None:
              "(ops/env_dynamics.py; 'on' falls back to plain XLA "
              "off-TPU, 'interpret' runs the kernels in pallas "
              "interpret mode on any backend — the CI parity path)",
+    )
+    ap.add_argument(
+        "--data_compress", choices=["off", "on", "interpret"],
+        default="off",
+        help="also run the billion-bar streaming probe: int16 tick-delta "
+             "tape + fused on-device decode (data/compress.py) vs the "
+             "uncompressed double buffer at the same HBM budget; adds "
+             "the stream_bars_per_sec / data_compression_ratio / "
+             "resident_bars keys (null when off)",
+    )
+    ap.add_argument(
+        "--stream_bars", type=int, default=229376,
+        help="generated tape length for the --data_compress probe "
+             "(weekly lookup tables amortize with length; the default "
+             "is ~32 weeks of minute bars — within one DST regime, "
+             "where the NY-calendar columns stay weekly-periodic; "
+             "--quick shrinks this to 32768)",
     )
     ap.add_argument(
         "--trace", type=str, default=None, metavar="DIR",
@@ -263,6 +353,7 @@ def main() -> None:
         return scengen_main(args)
     if args.quick:
         args.n_envs, args.horizon, args.iters = 256, 32, 2
+        args.stream_bars = min(args.stream_bars, 32768)
 
     from gymfx_tpu.bench_util import probe_device
 
@@ -466,6 +557,11 @@ def main() -> None:
                 if update_gemm_frac is not None else None
             ),
             "rollout_env_kernel": args.rollout_env_kernel,
+            # billion-bar data path probe (--data_compress; null when
+            # off): compressed streaming decode throughput and the
+            # resident-bars capacity vs the uncompressed double buffer
+            # at the same stream_hbm_budget_mb
+            **_stream_probe(args.data_compress, args.stream_bars),
         },
         analytic_flops=analytic,
         step_time_s=per_step_s,

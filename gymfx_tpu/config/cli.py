@@ -52,7 +52,7 @@ def parse_args(argv=None):
 
     # data feed: replayed CSV tape vs the generative scenario engine
     # (docs/scenarios.md)
-    parser.add_argument("--feed", choices=["replay", "scengen"])
+    parser.add_argument("--feed", choices=["replay", "scengen", "curriculum"])
     parser.add_argument(
         "--scengen_preset",
         choices=["regime_mix", "trend_calm", "range_chop", "flash_crash",
@@ -61,6 +61,17 @@ def parse_args(argv=None):
     )
     parser.add_argument("--scengen_bars", type=int)
     parser.add_argument("--scengen_seed", type=int)
+    parser.add_argument(
+        "--scengen_snap_to_tick", action="store_true", default=None
+    )
+
+    # billion-bar data path (docs/performance.md): compressed tapes and
+    # the dataset-of-tapes curriculum registry
+    parser.add_argument(
+        "--data_compress", choices=["off", "on", "interpret"]
+    )
+    parser.add_argument("--tapes", type=str)
+    parser.add_argument("--curriculum_seed", type=int)
 
     parser.add_argument("--replay_actions_file", type=str)
     parser.add_argument("--results_file", type=str)

@@ -67,6 +67,10 @@ DEFAULT_VALUES = {
                                      # from the training seed)
     "scengen_pairs": None,           # portfolio pair list (None = the
                                      # default 4 USD-quote pairs)
+    # snap generated OHLC onto the lob_tick_size grid at synthesis (f64,
+    # before the f32 cast) so scengen tapes satisfy data_compress's
+    # on-grid requirement; False = bitwise-identical generation
+    "scengen_snap_to_tick": False,
     "action_space_mode": "discrete",  # discrete|continuous
     "continuous_action_threshold": 0.33,
     "seed": 0,
@@ -124,6 +128,18 @@ DEFAULT_VALUES = {
     # the resident MarketData would exceed this many MiB (None = always
     # resident); rollout-only — trainers need the full history resident
     "stream_hbm_budget_mb": None,
+    # int16 tick-delta wire format for streamed shards and the
+    # curriculum tape library (data/compress.py, docs/performance.md
+    # "Billion-bar data path"): off = f32 everywhere (bitwise-identical
+    # default), on = fused Pallas decode on TPU, interpret = the same
+    # kernel interpreted (CPU-testable bitwise oracle)
+    "data_compress": "off",
+    # feed=curriculum tape registry: 'file:PATH[@W],scengen:PRESET[@W]'
+    # string or a JSON list of {file|scengen, weight, ...} dicts
+    # (data/tapes.py); tape 0 is the environment's own dataset
+    "tapes": None,
+    # PCG64 seed for the weighted tape draws (None = the training seed)
+    "curriculum_seed": None,
     # PPO minibatch source: env-permuted trajectory minibatches
     # (contiguous update-phase DMA; measured 12.4M vs 8.3M steps/s at
     # 8192 envs with identical held-out learning — the round-5 fix,

@@ -529,6 +529,38 @@ class PortfolioEnvironment:
         self.config = dict(config)
         account = str(config.get("account_currency", "USD"))
         feed = str(config.get("feed") or "replay").lower()
+        from gymfx_tpu.data.compress import validate_compress_mode
+
+        # honor-or-reject: the int16 wire format (data/compress.py)
+        # covers single-pair MarketData tapes; portfolio books are
+        # PortfolioData pytrees (stacked pair leaves + a conversion
+        # matrix) with no compressed form yet
+        if validate_compress_mode(config.get("data_compress", "off")) != "off":
+            raise ValueError(
+                "data_compress applies to single-pair MarketData tapes; "
+                "portfolio books (stacked pair leaves + a conversion "
+                "matrix) have no compressed form — unset data_compress "
+                "for the portfolio env"
+            )
+        self.curriculum = None
+        curriculum_specs = None
+        base_config = None
+        if feed == "curriculum":
+            from gymfx_tpu.data import tapes as tapes_mod
+
+            if split is not None:
+                raise ValueError(
+                    "feed=curriculum cannot be combined with eval_split "
+                    "on the portfolio env (which tape would be cut?); "
+                    "evaluate on a held-out book instead"
+                )
+            curriculum_specs = tapes_mod.parse_tape_specs(config)
+            base_config = dict(config)
+            # rebind this env to tape 0 — the overlay strips the
+            # curriculum keys, so the nested tape builds cannot recurse
+            config = tapes_mod.overlay_config(config, curriculum_specs[0])
+            self.config = dict(config)
+            feed = str(config.get("feed") or "replay").lower()
         if feed == "scengen":
             # correlated multi-asset generation on one shared grid —
             # already aligned, no timestamp join needed
@@ -677,6 +709,13 @@ class PortfolioEnvironment:
         for prof in profiles:
             validate_profile_latency(prof, bar_ms)
         self.timeframe_hours = datasets[0].timeframe_hours
+
+        if curriculum_specs is not None:
+            from gymfx_tpu.data import tapes as tapes_mod
+
+            self.curriculum = tapes_mod.PortfolioCurriculumSampler(
+                base_config, curriculum_specs, base_env=self
+            )
 
     @property
     def n_bars(self) -> int:

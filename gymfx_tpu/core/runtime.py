@@ -90,8 +90,17 @@ class Environment:
         # feed dispatch: "replay" (the default — bitwise-identical code
         # path when the knob is unset) loads the CSV dataset; "scengen"
         # synthesizes a seed-deterministic scenario tape through the
-        # SAME MarketDataset pipeline (gymfx_tpu/scengen/, docs/scenarios.md)
+        # SAME MarketDataset pipeline (gymfx_tpu/scengen/, docs/scenarios.md);
+        # "curriculum" samples over a registry of tapes (data/tapes.py) —
+        # tape 0 of the registry is this Environment's dataset, the
+        # sampler itself is built after the device data exists (below)
         feed = str(config.get("feed") or "replay").lower()
+        self.curriculum = None
+        curriculum_specs = None
+        if feed == "curriculum":
+            from gymfx_tpu.data import tapes as tapes_mod
+
+            curriculum_specs = tapes_mod.parse_tape_specs(self.config)
         if dataset is not None:
             self.dataset = dataset
         elif feed == "replay":
@@ -100,8 +109,16 @@ class Environment:
             from gymfx_tpu.scengen.feed import ScenGenDataset
 
             self.dataset = ScenGenDataset(self.config)
+        elif feed == "curriculum":
+            from gymfx_tpu.data import tapes as tapes_mod
+
+            self.dataset = tapes_mod.dataset_for_spec(
+                self.config, curriculum_specs[0]
+            )
         else:
-            raise ValueError(f"feed must be replay|scengen, got {feed!r}")
+            raise ValueError(
+                f"feed must be replay|scengen|curriculum, got {feed!r}"
+            )
         if len(self.dataset) < int(config.get("window_size", 32)) + 2:
             raise ValueError(
                 "input data is empty or too short for the configured window"
@@ -150,6 +167,15 @@ class Environment:
         self.stream_budget_mb: Optional[float] = (
             float(budget) if budget else None
         )
+        from gymfx_tpu.data.compress import validate_compress_mode
+
+        # int16 tick-delta wire format for streamed shards and the
+        # curriculum tape library (data/compress.py); "off" (default)
+        # leaves every existing path bitwise untouched
+        self.data_compress = validate_compress_mode(
+            config.get("data_compress", "off")
+        )
+        self.tick_size = float(config.get("lob_tick_size", 1e-5) or 1e-5)
         md_kwargs = dict(
             window_size=self.cfg.window_size,
             feature_columns=feature_columns,
@@ -182,12 +208,20 @@ class Environment:
             if market_data_nbytes(host) > self.stream_budget_mb * 2**20:
                 # streamed: shards are uploaded on demand (rollout path);
                 # no resident device copy exists
-                self.host_data = host
                 self.streamer = BarStreamer(
                     host,
                     window_size=self.cfg.window_size,
                     budget_mb=self.stream_budget_mb,
+                    compress=self.data_compress,
+                    tick_size=self.tick_size,
                 )
+                # compressed mode never holds the f32 tape host-side;
+                # generated feeds can also drop their f64 frame so a
+                # large scengen tape exists in ONE representation only
+                self.host_data = self.streamer.host_data
+                if self.data_compress != "off":
+                    del host
+                    self.dataset.release_frame()
                 self.data = None
             else:
                 # fits the budget after all — resident, bit-identical to
@@ -195,6 +229,27 @@ class Environment:
                 self.data = jax.tree.map(jax.device_put, host)
         else:
             self.data: MarketData = self.dataset.build_market_data(**md_kwargs)
+
+        if curriculum_specs is not None:
+            if self.streamer is not None:
+                raise ValueError(
+                    "feed=curriculum cannot be combined with shard "
+                    "streaming (stream_hbm_budget_mb="
+                    f"{self.stream_budget_mb}): the sampler swaps whole "
+                    "tapes at superstep boundaries; raise the budget or "
+                    "compress the tape library with data_compress=on"
+                )
+            from gymfx_tpu.data import tapes as tapes_mod
+
+            self.curriculum = tapes_mod.CurriculumSampler(
+                self.config,
+                curriculum_specs,
+                base_dataset=self.dataset,
+                base_data=self.data,
+                md_kwargs=md_kwargs,
+                compress=self.data_compress,
+                tick_size=self.tick_size,
+            )
 
     # ------------------------------------------------------------------
     @property

@@ -118,7 +118,21 @@ class MarketDataset:
             self.timestamps = pd.Series(pd.DatetimeIndex([pd.NaT] * len(dataframe)))
 
     def __len__(self) -> int:
+        if self.dataframe is None:
+            return self._released_len
         return len(self.dataframe)
+
+    def release_frame(self) -> None:
+        """Drop the host dataframe once the device tensors exist.
+
+        Large generated feeds (feed=scengen at big ``scengen_bars``)
+        otherwise hold the f64 frame AND its encoded device form at the
+        same time; timestamps and length survive so latency validation
+        and ``len()`` keep working.  Building market data again after a
+        release fails loudly."""
+        if self.dataframe is not None:
+            self._released_len = len(self.dataframe)
+            self.dataframe = None
 
     def bar_interval_ms(self) -> Optional[float]:
         """Milliseconds per bar: from the timeframe label when present,
@@ -154,6 +168,12 @@ class MarketDataset:
         device: bool = True,
     ) -> MarketData:
         df = self.dataframe
+        if df is None:
+            raise ValueError(
+                "this dataset's frame was released (release_frame) after "
+                "its device tensors were built — market data cannot be "
+                "rebuilt from it"
+            )
         n = len(df)
         if n < window_size + 2:
             raise ValueError("input data is empty or too short for the configured window")
@@ -328,6 +348,25 @@ def market_data_nbytes(data: MarketData) -> int:
     return total
 
 
+def market_data_nbytes_report(data: MarketData, tape=None) -> Dict[str, Any]:
+    """Decoded vs compressed byte accounting for one tape.
+
+    ``decoded`` is the full-width f32 footprint of ``data``;
+    ``compressed`` is the int16/packed footprint of its
+    :class:`~gymfx_tpu.data.compress.CompressedTape` (None when the tape
+    is not compressed), with ``ratio = decoded_per_shard * num_shards /
+    compressed`` as defined by the tape."""
+    decoded = market_data_nbytes(data) if data is not None else None
+    if tape is None:
+        return {"decoded": decoded, "compressed": None, "ratio": None}
+    return {
+        "decoded": decoded if decoded is not None
+        else tape.decoded_shard_nbytes * tape.num_shards,
+        "compressed": tape.nbytes,
+        "ratio": tape.compression_ratio,
+    }
+
+
 def shard_market_data(data: MarketData, start: int, shard_bars: int,
                       window_size: int) -> MarketData:
     """Slice one streaming shard out of a (host) MarketData.
@@ -385,12 +424,24 @@ class BarStreamer:
     previous one, so the host→device DMA of shard ``t+1`` overlaps the
     device compute on shard ``t``.  At most two shards are resident at
     any time, which is why each shard targets half the budget.
+
+    ``compress != "off"`` switches the wire format to int16 tick-deltas
+    (data/compress.py): the planner then budgets on the COMPRESSED
+    resident size plus two decoded shards (the double buffer), the whole
+    compressed tape stays device-resident when the ring capacity allows,
+    and ``_device_shard`` materializes each f32 shard with the fused
+    decode — bitwise-identical to the uncompressed slice, verified at
+    encode time.  The host f32 tape is dropped after encoding so large
+    generated feeds never hold both representations at once.
     """
 
     def __init__(self, host_data: MarketData, *, window_size: int,
                  budget_mb: float, min_shard_bars: int = 64,
-                 placement=None):
-        self.host_data = host_data
+                 placement=None, compress: str = "off",
+                 tick_size: float = 1e-5, what: str = ""):
+        from gymfx_tpu.data import compress as C
+
+        self.compress = C.validate_compress_mode(compress)
         self.window_size = int(window_size)
         # optional jax.sharding.Sharding for each shard's device_put —
         # on a mesh the ShardedRuntime passes its replicated sharding so
@@ -402,7 +453,18 @@ class BarStreamer:
         total = market_data_nbytes(host_data)
         per_bar = max(1.0, total / max(1, n))
         budget_bytes = float(budget_mb) * 2**20
-        shard_bars = int(budget_bytes / 2.0 / per_bar) - self.window_size - 1
+        if self.compress == "off":
+            shard_bars = (
+                int(budget_bytes / 2.0 / per_bar) - self.window_size - 1
+            )
+        else:
+            # two DECODED f32 buffers take an eighth of the budget; the
+            # rest holds the compressed resident ring (checked below
+            # once the actual compressed size is known)
+            shard_bars = (
+                int(budget_bytes * 0.125 / 2.0 / per_bar)
+                - self.window_size - 1
+            )
         shard_bars = max(int(min_shard_bars), shard_bars)
         if shard_bars >= n - 1:
             raise ValueError(
@@ -421,9 +483,61 @@ class BarStreamer:
             starts.append(last)
         self.starts = starts
 
+        self.tape = None
+        self._decoder = None
+        self.ring_shards = 2  # uncompressed: the double buffer
+        if self.compress == "off":
+            self.host_data = host_data
+            return
+        import jax
+
+        tape = C.encode_market_data(
+            host_data, starts=starts, shard_bars=shard_bars,
+            window_size=self.window_size, tick_size=tick_size, what=what,
+        )
+        ring_bytes = budget_bytes - 2.0 * tape.decoded_shard_nbytes
+        ring = int(ring_bytes // max(1, tape.shard_nbytes))
+        if ring < 2:
+            raise ValueError(
+                f"stream_hbm_budget_mb={budget_mb} cannot hold two "
+                f"decoded shards ({2 * tape.decoded_shard_nbytes / 2**20:.1f}"
+                " MiB) plus two compressed shards "
+                f"({tape.shard_nbytes / 2**20:.2f} MiB each, "
+                f"{tape.nbytes / 2**20:.1f} MiB total compressed) — raise "
+                "the budget or set data_compress=off"
+            )
+        self.ring_shards = min(ring, len(starts))
+        # full compressed tape fits the ring: park it on device once and
+        # decode shards from resident slabs (no steady-state host DMA);
+        # otherwise stream the (4x smaller) compressed shards from host
+        self.tape_resident = ring >= len(starts)
+        if self.tape_resident:
+            tape = C.device_tape(tape, placement)
+        self.tape = tape
+        self._decoder = C.make_shard_decoder(tape, self.compress)
+        # drop the host f32 reference: compressed mode never holds the
+        # full-width tape and its compressed form at the same time
+        self.host_data = None
+
     @property
     def num_shards(self) -> int:
         return len(self.starts)
+
+    @property
+    def resident_bars(self) -> int:
+        """Bar capacity resident on device under the budget: the ring of
+        compressed shards (plus decode buffers) when compressed, the
+        double buffer otherwise."""
+        return self.ring_shards * self.shard_bars
+
+    @property
+    def compression_ratio(self) -> Optional[float]:
+        return None if self.tape is None else self.tape.compression_ratio
+
+    def nbytes_report(self) -> Dict[str, Any]:
+        """Compressed vs decoded byte accounting (see
+        :func:`market_data_nbytes_report`)."""
+        return market_data_nbytes_report(self.host_data, self.tape)
 
     def serve_ranges(self):
         """[(lo, hi_or_None), ...]: shard k serves bar cursors in
@@ -437,6 +551,25 @@ class BarStreamer:
     def _device_shard(self, k: int) -> MarketData:
         import jax
 
+        if self.tape is not None:
+            from gymfx_tpu.data import compress as C
+
+            arrs = C.shard_arrays(self.tape, k)
+            if not self.tape_resident:
+                # stream the compressed shard (4x+ smaller DMA), decode
+                # on device into the f32 double buffer
+                if self.placement is not None:
+                    arrs = jax.tree.map(
+                        lambda x: jax.device_put(x, self.placement), arrs
+                    )
+                else:
+                    arrs = jax.tree.map(jax.device_put, arrs)
+            shard = self._decoder(arrs)
+            if self.placement is not None:
+                shard = jax.tree.map(
+                    lambda x: jax.device_put(x, self.placement), shard
+                )
+            return shard
         shard = shard_market_data(
             self.host_data, self.starts[k], self.shard_bars, self.window_size
         )

@@ -205,16 +205,21 @@ class ShardedRuntime:
         return jax.tree.map(lambda x: jax.device_put(x, rep), data)
 
     def bar_streamer(self, host_data: Any, *, window_size: int,
-                     budget_mb: float, min_shard_bars: int = 64):
+                     budget_mb: float, min_shard_bars: int = 64,
+                     compress: str = "off", tick_size: float = 1e-5):
         """A double-buffered :class:`~gymfx_tpu.data.feed.BarStreamer`
         whose ``shard_market_data`` shards are placed across the mesh
         (host→device DMA of shard ``t+1`` still overlaps compute on
-        shard ``t``; only the placement target changes)."""
+        shard ``t``; only the placement target changes).  With
+        ``compress`` on, the int16 tapes ride the same placement and the
+        fused decode materializes each replicated f32 shard on device
+        (data/compress.py)."""
         from gymfx_tpu.data.feed import BarStreamer
 
         return BarStreamer(
             host_data, window_size=window_size, budget_mb=budget_mb,
             min_shard_bars=min_shard_bars, placement=self.replicated(),
+            compress=compress, tick_size=tick_size,
         )
 
     # ------------------------------------------------------------------

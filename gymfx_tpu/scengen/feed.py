@@ -103,6 +103,27 @@ def _paths_to_frame(
     return df
 
 
+def _snap_to_tick(df: pd.DataFrame, tick: float) -> pd.DataFrame:
+    """Snap generated OHLC onto the LOB's int-tick grid (f64 rounding,
+    BEFORE the pipeline's f32 cast) so the tape satisfies the int16
+    tick-delta wire format's on-grid requirement (data/compress.py).
+    Rounding can push a bar's high below its open/close by half a tick;
+    the hull is re-closed on the grid."""
+    for col in ("OPEN", "HIGH", "LOW", "CLOSE"):
+        df[col] = np.round(df[col].to_numpy(np.float64) / tick) * tick
+    o, c = df["OPEN"].to_numpy(), df["CLOSE"].to_numpy()
+    df["HIGH"] = np.maximum.reduce([df["HIGH"].to_numpy(), o, c])
+    df["LOW"] = np.minimum.reduce([df["LOW"].to_numpy(), o, c])
+    return df
+
+
+def _maybe_snap(df: pd.DataFrame, config: Dict[str, Any]) -> pd.DataFrame:
+    if not config.get("scengen_snap_to_tick"):
+        return df  # default: bitwise-identical generation
+    tick = float(config.get("lob_tick_size", 1e-5) or 1e-5)
+    return _snap_to_tick(df, tick)
+
+
 def _scengen_knobs(config: Dict[str, Any]) -> Tuple[str, int, int, float]:
     preset = str(config.get("scengen_preset") or DEFAULT_PRESET)
     n_bars = int(config.get("scengen_bars") or DEFAULT_BARS)
@@ -134,7 +155,7 @@ def synthesize_frame(
         np.asarray(paths.low)[:, 0], np.asarray(paths.close)[:, 0],
         np.asarray(paths.spread_mult), np.asarray(paths.slip_mult),
     )
-    return df, np.asarray(paths.flags, np.int32)
+    return _maybe_snap(df, config), np.asarray(paths.flags, np.int32)
 
 
 def _parse_pairs(value: Any) -> List[str]:
@@ -184,8 +205,11 @@ def synthesize_portfolio_frames(
     sp = np.asarray(paths.spread_mult)
     sl = np.asarray(paths.slip_mult)
     aligned = {
-        pair: _paths_to_frame(index, o[:, i], h[:, i], l[:, i], c[:, i],
-                              sp, sl)
+        pair: _maybe_snap(
+            _paths_to_frame(index, o[:, i], h[:, i], l[:, i], c[:, i],
+                            sp, sl),
+            config,
+        )
         for i, pair in enumerate(pairs)
     }
     return pairs, aligned, np.asarray(paths.flags, np.int32)

@@ -53,6 +53,7 @@ EVENT_KINDS = (
     "replica_up",
     "replica_down",
     "replica_failover",
+    "curriculum_pick",
 )
 
 

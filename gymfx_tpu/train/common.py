@@ -40,6 +40,23 @@ def make_train_many(step_impl):
     return jax.jit(impl, static_argnums=1, donate_argnums=0)
 
 
+def make_train_many_with_data(step_impl):
+    """Curriculum variant of :func:`make_train_many`: jitted
+    ``train_many(state, data, k)`` where the MarketData tape is a traced
+    argument instead of a closure constant, so ONE compiled superstep
+    serves every tape of the registry (all tapes share static shapes).
+    Only the state is donated — the tape is owned by the sampler and
+    reused across supersteps."""
+
+    def impl(state, data, k: int):
+        def body(s, _):
+            return step_impl(s, data)
+
+        return jax.lax.scan(body, state, None, length=k)
+
+    return jax.jit(impl, static_argnums=2, donate_argnums=0)
+
+
 def make_train_many_overlapped(
     rollout_phase, update_phase, learner_fields=("params", "opt_state"),
 ):
@@ -124,13 +141,19 @@ def build_train_eval_envs(config: Dict[str, Any]) -> Tuple[Any, Optional[Any]]:
     feed = str(config.get("feed") or "replay").lower()
     if eval_file and split:
         raise ValueError("set either eval_data_file or eval_split, not both")
+    if feed == "curriculum" and split:
+        raise ValueError(
+            "feed=curriculum cannot hold out via eval_split (which tape "
+            "would be cut?); name a held-out tape with eval_data_file"
+        )
     if eval_file:
         eval_config = dict(config)
         eval_config["input_data_file"] = str(eval_file)
-        if feed == "scengen":
+        if feed in ("scengen", "curriculum"):
             # train-on-synthetic / eval-on-real: the named eval file is
             # by definition a replayed tape
             eval_config["feed"] = "replay"
+            eval_config.pop("tapes", None)
         return Environment(config), Environment(eval_config)
     if split:
         frac = float(split)

@@ -174,8 +174,26 @@ class ImpalaTrainer:
         from gymfx_tpu.train.common import (
             make_train_many,
             make_train_many_overlapped,
+            make_train_many_with_data,
         )
 
+        # feed=curriculum: tape swaps at superstep boundaries with the
+        # tape as a traced argument (see PPOTrainer)
+        self.curriculum = getattr(env, "curriculum", None)
+        if self.curriculum is not None and icfg.superstep_overlap:
+            raise ValueError(
+                "feed=curriculum cannot be combined with "
+                "superstep_overlap: the pipelined driver issues rollout "
+                "i+1 before update i, so a tape swap inside the dispatch "
+                "would feed half a superstep from the wrong tape"
+            )
+        if self.curriculum is not None:
+            self._train_step_data = jax.jit(
+                self._train_step_impl, donate_argnums=0
+            )
+            self._train_many_data = make_train_many_with_data(
+                self._train_step_impl
+            )
         if icfg.superstep_overlap:
             # the update phase owns both param sets (learner gradients,
             # periodic actor sync) and the staleness counter
@@ -234,13 +252,24 @@ class ImpalaTrainer:
         return state
 
     # ------------------------------------------------------------------
-    def _rollout(self, actor_params, env_states, obs_vec, pcarry, rng):
-        cfg, eparams, data = self.env.cfg, self.env.params, self.env.data
+    def _rollout(self, actor_params, env_states, obs_vec, pcarry, rng,
+                 data=None):
+        cfg, eparams = self.env.cfg, self.env.params
+        # data=None keeps the baked resident tape (bitwise-identical
+        # default); an explicit tape (curriculum) is traced and supplies
+        # its own in-graph reset (see PPOTrainer._rollout)
+        explicit_data = data is not None
+        if not explicit_data:
+            data = self.env.data
         vstep = jax.vmap(env_core.step, in_axes=(None, None, None, 0, 0))
         vencode = jax.vmap(self._encode)
         fwd = jax.vmap(self._forward, in_axes=(None, 0, 0))
         carry0 = self.policy.initial_carry(())
-        reset_state, reset_vec = self._reset_state, self._reset_vec
+        if explicit_data:
+            reset_state, fresh_obs = env_core.reset(cfg, eparams, data)
+            reset_vec = self._encode(fresh_obs)
+        else:
+            reset_state, reset_vec = self._reset_state, self._reset_vec
 
         continuous = self._continuous
 
@@ -357,7 +386,7 @@ class ImpalaTrainer:
             mean_rho=rhos.mean(),
         )
 
-    def _rollout_phase(self, state: ImpalaState):
+    def _rollout_phase(self, state: ImpalaState, data=None):
         """Phase 1: collect one unroll with the (stale) actor params.
         ``rollout_out`` carries the PRE-rollout policy carry alongside
         the segment: the learner replay unrolls the segment from the
@@ -367,7 +396,7 @@ class ImpalaTrainer:
         superstep bit-identity tests pin the factoring)."""
         env_states, obs_vec, pcarry, rng, traj = self._rollout(
             state.actor_params, state.env_states, state.obs_vec,
-            state.policy_carry, state.rng,
+            state.policy_carry, state.rng, data,
         )
         inter = state._replace(
             env_states=env_states, obs_vec=obs_vec, policy_carry=pcarry,
@@ -375,10 +404,19 @@ class ImpalaTrainer:
         )
         return inter, (traj, state.policy_carry)
 
-    def _update_phase(self, state: ImpalaState, rollout_out):
+    def _update_phase(self, state: ImpalaState, rollout_out, data=None):
         """Phase 2: one V-trace learner update on the collected segment
         (+ guard bookkeeping and the staleness-sync counter)."""
         traj, init_carry = rollout_out
+        if data is not None:
+            # curriculum quarantine resets come from the ACTIVE tape
+            # (XLA CSEs with the rollout's identical reset)
+            reset_state, reset_obs = env_core.reset(
+                self.env.cfg, self.env.params, data
+            )
+            reset_vec = self._encode(reset_obs)
+        else:
+            reset_state, reset_vec = self._reset_state, self._reset_vec
         env_states, obs_vec, pcarry, rng = (
             state.env_states, state.obs_vec, state.policy_carry, state.rng
         )
@@ -429,8 +467,8 @@ class ImpalaTrainer:
                 env_axis=0, mode="nan",
             )
             carry0 = self.policy.initial_carry(())
-            env_states = masked_reset(poison, self._reset_state, env_states)
-            obs_vec = masked_reset(poison, self._reset_vec, obs_vec)
+            env_states = masked_reset(poison, reset_state, env_states)
+            obs_vec = masked_reset(poison, reset_vec, obs_vec)
             pcarry = masked_reset(poison, carry0, pcarry)
             metrics["poisoned_env_resets"] = poison.astype(jnp.float32).sum()
         else:
@@ -453,13 +491,13 @@ class ImpalaTrainer:
             metrics,
         )
 
-    def _train_step_impl(self, state: ImpalaState):
+    def _train_step_impl(self, state: ImpalaState, data=None):
         # phase-named XLA ops for profiler attribution (trace-time
         # metadata only; numerics unchanged) — same scheme as PPO
         with jax.named_scope("rollout"):
-            inter, rollout_out = self._rollout_phase(state)
+            inter, rollout_out = self._rollout_phase(state, data)
         with jax.named_scope("update"):
-            return self._update_phase(inter, rollout_out)
+            return self._update_phase(inter, rollout_out, data)
 
     # ------------------------------------------------------------------
     def train_step(self, state: ImpalaState):
@@ -555,12 +593,23 @@ class ImpalaTrainer:
         while it < iters:
             k = min(K, iters - it)
             capturing = hooks.begin_superstep(it, k)
+            # curriculum: one seed-deterministic weighted tape draw per
+            # superstep boundary (ledgered as a curriculum_pick row)
+            tape = None
+            if self.curriculum is not None:
+                _ti, _label, tape = self.curriculum.pick(it)
             with tracer.span("train/superstep", algo="impala", it=it, k=k):
                 if k == 1:
-                    state, metrics = self.train_step(state)
+                    if tape is None:
+                        state, metrics = self.train_step(state)
+                    else:
+                        state, metrics = self._train_step_data(state, tape)
                     guard_metrics = metrics
                 else:
-                    state, stacked = self.train_many(state, k)
+                    if tape is None:
+                        state, stacked = self.train_many(state, k)
+                    else:
+                        state, stacked = self._train_many_data(state, tape, k)
                     metrics = jax.tree.map(lambda x: x[-1], stacked)
                     guard_metrics = stacked
             if capturing:

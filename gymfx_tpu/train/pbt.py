@@ -76,11 +76,11 @@ class _InjectedHyperMixin:
             ent_coef=self.pcfg.ent_coef,
         )
 
-    def _train_step_impl(self, state):
+    def _train_step_impl(self, state, data=None):
         h = state.opt_state.hyperparams
         self._hyper = (h["clip_eps"], h["ent_coef"])
         try:
-            return super()._train_step_impl(state)
+            return super()._train_step_impl(state, data)
         finally:
             self._hyper = None
 
@@ -123,6 +123,14 @@ class PBTTrainer:
             self.runtime = ShardedRuntime(mesh)
             self.runtime.validate_population(pbt.population)
         self._vstep = jax.jit(jax.vmap(self.trainer._train_step_impl), donate_argnums=0)
+        # curriculum feed: one tape per population step, shared (in_axes
+        # None) across members so every member trains the same market
+        # while hyperparameters differ — the tape is never donated
+        self.curriculum = getattr(self.trainer, "curriculum", None)
+        self._vstep_data = jax.jit(
+            jax.vmap(self.trainer._train_step_impl, in_axes=(0, None)),
+            donate_argnums=0,
+        )
         self._vinit = jax.jit(jax.vmap(self.trainer.init_state_from_key))
 
     # ------------------------------------------------------------------
@@ -207,7 +215,11 @@ class PBTTrainer:
         t0 = time.perf_counter()
         metrics = {}
         for it in range(iters):
-            states, metrics = self._vstep(states)
+            if self.curriculum is not None:
+                _ti, _label, tape = self.curriculum.pick(it)
+                states, metrics = self._vstep_data(states, tape)
+            else:
+                states, metrics = self._vstep(states)
             step_fit = np.asarray(metrics["mean_reward"], np.float64)
             fitness = decay * fitness + (1 - decay) * step_fit
             if (it + 1) % self.pbt.interval == 0 and it + 1 < iters:

@@ -214,6 +214,11 @@ class PortfolioPPOTrainer:
         self._is_transformer = is_token_policy(pcfg.policy)
         self._reset_vec = self._encode(reset_obs)
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+        # curriculum feed (data/tapes.py): the sampler picks a tape per
+        # iteration and the step runs against it as a traced argument —
+        # donate the state only, never the shared tape
+        self.curriculum = getattr(env, "curriculum", None)
+        self._train_step_data = jax.jit(self._train_step_impl, donate_argnums=0)
 
     def _encode(self, obs):
         if self._is_transformer:
@@ -249,12 +254,22 @@ class PortfolioPPOTrainer:
     def _forward(self, params, x):
         return self.policy.apply(params, x)
 
-    def _rollout(self, params, env_states, obs_vec, rng):
-        cfg, eparams, data = self.env.cfg, self.env.params, self.env.data
+    def _rollout(self, params, env_states, obs_vec, rng, data=None):
+        cfg, eparams = self.env.cfg, self.env.params
+        explicit_data = data is not None
+        if not explicit_data:
+            data = self.env.data
         vstep = jax.vmap(P.step, in_axes=(None, None, None, 0, 0))
         vencode = jax.vmap(self._encode)
         fwd = jax.vmap(self._forward, in_axes=(None, 0))
-        reset_state, reset_vec = self._reset_state, self._reset_vec
+        if explicit_data:
+            # curriculum tape: episode restarts must come from the ACTIVE
+            # tape, so the reset rides the trace instead of the baked
+            # (tape-0) constants
+            reset_state, fresh_obs = P.reset(cfg, eparams, data)
+            reset_vec = self._encode(fresh_obs)
+        else:
+            reset_state, reset_vec = self._reset_state, self._reset_vec
 
         def body(carry, _):
             env_states, obs_vec, rng = carry
@@ -335,12 +350,12 @@ class PortfolioPPOTrainer:
         overrides with per-member traced values (train/pbt.py)."""
         return self.pcfg.clip_eps, self.pcfg.ent_coef
 
-    def _rollout_phase(self, state: PortfolioTrainState):
+    def _rollout_phase(self, state: PortfolioTrainState, data=None):
         """Phase 1 of the train step (see train/ppo.py _rollout_phase:
         the split exists for bench phase attribution and is pinned to
         compose bitwise into ``_train_step_impl``)."""
         env_states, obs_vec, rng, traj, bootstrap = self._rollout(
-            state.params, state.env_states, state.obs_vec, state.rng
+            state.params, state.env_states, state.obs_vec, state.rng, data
         )
         inter = PortfolioTrainState(
             state.params, state.opt_state, env_states, obs_vec, rng
@@ -403,8 +418,8 @@ class PortfolioPPOTrainer:
         )
         return PortfolioTrainState(params, opt_state, env_states, obs_vec, rng), metrics
 
-    def _train_step_impl(self, state: PortfolioTrainState):
-        inter, rollout_out = self._rollout_phase(state)
+    def _train_step_impl(self, state: PortfolioTrainState, data=None):
+        inter, rollout_out = self._rollout_phase(state, data)
         return self._update_phase(inter, rollout_out)
 
     def train_step(self, state):
@@ -432,8 +447,12 @@ class PortfolioPPOTrainer:
         iters = max(1, int(total_env_steps) // per_iter)
         t0 = time.perf_counter()
         metrics: Dict[str, Any] = {}
-        for _ in range(iters):
-            state, metrics = self.train_step(state)
+        for it in range(iters):
+            if self.curriculum is not None:
+                _ti, _label, tape = self.curriculum.pick(it)
+                state, metrics = self._train_step_data(state, tape)
+            else:
+                state, metrics = self.train_step(state)
         jax.block_until_ready(state.params)
         out = {k: float(v) for k, v in metrics.items()}
         out["env_steps_per_sec"] = per_iter * iters / (time.perf_counter() - t0)

@@ -228,8 +228,27 @@ class PPOTrainer:
         from gymfx_tpu.train.common import (
             make_train_many,
             make_train_many_overlapped,
+            make_train_many_with_data,
         )
 
+        # feed=curriculum: the sampler swaps whole tapes at superstep
+        # boundaries, so the tape becomes a TRACED train_many argument
+        # (make_train_many_with_data) — one executable serves every tape
+        self.curriculum = getattr(env, "curriculum", None)
+        if self.curriculum is not None and pcfg.superstep_overlap:
+            raise ValueError(
+                "feed=curriculum cannot be combined with "
+                "superstep_overlap: the pipelined driver issues rollout "
+                "i+1 before update i, so a tape swap inside the dispatch "
+                "would feed half a superstep from the wrong tape"
+            )
+        if self.curriculum is not None:
+            self._train_step_data = jax.jit(
+                self._train_step_impl, donate_argnums=0
+            )
+            self._train_many_data = make_train_many_with_data(
+                self._train_step_impl
+            )
         if pcfg.superstep_overlap:
             self._train_many = make_train_many_overlapped(
                 self._rollout_phase, self._update_phase
@@ -285,8 +304,15 @@ class PPOTrainer:
         logits, value = self.policy.apply(params, obs_vec)
         return logits, value, pcarry
 
-    def _rollout(self, params, env_states, obs_vec, pcarry, rng):
-        cfg, eparams, data = self.env.cfg, self.env.params, self.env.data
+    def _rollout(self, params, env_states, obs_vec, pcarry, rng, data=None):
+        cfg, eparams = self.env.cfg, self.env.params
+        # data=None (every non-curriculum path) bakes the env's resident
+        # tape into the trace exactly as before — bitwise identical; an
+        # explicit tape (curriculum) is a traced argument, so the reset
+        # state/obs must be derived from IT in-graph
+        explicit_data = data is not None
+        if not explicit_data:
+            data = self.env.data
         vstep = jax.vmap(env_core.step, in_axes=(None, None, None, 0, 0))
         vencode = jax.vmap(self._encode)
         fwd = jax.vmap(self._policy_forward, in_axes=(None, 0, 0))
@@ -303,6 +329,9 @@ class PPOTrainer:
                 env_core.reset_at, in_axes=(None, None, None, 0)
             )(cfg, eparams, data, t0s)
             reset_vec = vencode(fresh_obs)
+        elif explicit_data:
+            reset_state, fresh_obs = env_core.reset(cfg, eparams, data)
+            reset_vec = self._encode(fresh_obs)
         else:
             reset_state = self._reset_state
             reset_vec = self._reset_vec
@@ -420,7 +449,7 @@ class PPOTrainer:
         them independently (train/pbt.py)."""
         return self.pcfg.clip_eps, self.pcfg.ent_coef
 
-    def _rollout_phase(self, state: TrainState):
+    def _rollout_phase(self, state: TrainState, data=None):
         """Phase 1 of the train step: collect one horizon of experience.
         Returns the post-rollout carry state (params/opt untouched) and
         the rollout products the update consumes.  ``_train_step_impl``
@@ -430,17 +459,27 @@ class PPOTrainer:
         bit-identity tests (tests/test_superstep.py) pin the factoring."""
         env_states, obs_vec, pcarry_end, rng, traj, last_value = self._rollout(
             state.params, state.env_states, state.obs_vec, state.policy_carry,
-            state.rng,
+            state.rng, data,
         )
         inter = TrainState(
             state.params, state.opt_state, env_states, obs_vec, pcarry_end, rng
         )
         return inter, (traj, last_value)
 
-    def _update_phase(self, state: TrainState, rollout_out):
+    def _update_phase(self, state: TrainState, rollout_out, data=None):
         """Phase 2 of the train step: GAE + minibatched epochs + guard
         bookkeeping on an already-collected trajectory."""
         pcfg = self.pcfg
+        if data is not None:
+            # curriculum: quarantine resets must come from the ACTIVE
+            # tape, not the baked tape-0 reset (XLA CSEs this with the
+            # rollout's identical reset when both phases share a trace)
+            reset_state, reset_obs = env_core.reset(
+                self.env.cfg, self.env.params, data
+            )
+            reset_vec = self._encode(reset_obs)
+        else:
+            reset_state, reset_vec = self._reset_state, self._reset_vec
         traj, last_value = rollout_out
         env_states, obs_vec, pcarry_end, rng = (
             state.env_states, state.obs_vec, state.policy_carry, state.rng
@@ -557,8 +596,8 @@ class PPOTrainer:
                 env_axis=0, mode="nan",
             )
             carry0 = self.policy.initial_carry(())
-            env_states = masked_reset(poison, self._reset_state, env_states)
-            obs_vec = masked_reset(poison, self._reset_vec, obs_vec)
+            env_states = masked_reset(poison, reset_state, env_states)
+            obs_vec = masked_reset(poison, reset_vec, obs_vec)
             pcarry_end = masked_reset(poison, carry0, pcarry_end)
             metrics["poisoned_env_resets"] = poison.astype(jnp.float32).sum()
         else:
@@ -575,14 +614,14 @@ class PPOTrainer:
         )
         return new_state, metrics
 
-    def _train_step_impl(self, state: TrainState):
+    def _train_step_impl(self, state: TrainState, data=None):
         # named_scope labels the XLA ops by phase (trace-time metadata
         # only — the compiled program and numerics are unchanged), so a
         # profiler capture attributes device time to rollout vs update
         with jax.named_scope("rollout"):
-            inter, rollout_out = self._rollout_phase(state)
+            inter, rollout_out = self._rollout_phase(state, data)
         with jax.named_scope("update"):
-            return self._update_phase(inter, rollout_out)
+            return self._update_phase(inter, rollout_out, data)
 
     # ------------------------------------------------------------------
     def train_step(self, state: TrainState):
@@ -701,12 +740,23 @@ class PPOTrainer:
         while it < iters:
             k = min(K, iters - it)
             capturing = hooks.begin_superstep(it, k)
+            # curriculum: one weighted seed-deterministic tape draw per
+            # superstep boundary (ledgered as a curriculum_pick row)
+            tape = None
+            if self.curriculum is not None:
+                _ti, _label, tape = self.curriculum.pick(it)
             with tracer.span("train/superstep", algo="ppo", it=it, k=k):
                 if k == 1:
-                    state, metrics = self.train_step(state)
+                    if tape is None:
+                        state, metrics = self.train_step(state)
+                    else:
+                        state, metrics = self._train_step_data(state, tape)
                     guard_metrics = metrics
                 else:
-                    state, stacked = self.train_many(state, k)
+                    if tape is None:
+                        state, stacked = self.train_many(state, k)
+                    else:
+                        state, stacked = self._train_many_data(state, tape, k)
                     # newest iteration's metrics, still on device (no sync)
                     metrics = jax.tree.map(lambda x: x[-1], stacked)
                     guard_metrics = stacked

@@ -1,0 +1,329 @@
+"""Compressed on-device tapes (gymfx_tpu/data/compress.py) + fused
+decode (gymfx_tpu/ops/tape_decode.py).  Pinned here:
+
+  * codec fits are honor-or-reject: every accepted codec round-trips
+    BITWISE against the f32 host tape (verified in numpy at encode
+    time), off-grid prices and >int16 tick spans raise loudly;
+  * a multi-shard BarStreamer in data_compress=on|interpret decodes
+    every shard — including the anchored remainder shard — bit-identical
+    to ``shard_market_data`` on the uncompressed host tape, with the
+    right global ``row0`` on each shard;
+  * the periodic table codecs (iperiodic: global-bar-index mod one
+    week of bar slots; periodic: gather by decoded minute_of_week)
+    engage only when the table is smaller than the slab it replaces,
+    and still round-trip bitwise;
+  * the streaming planner budgets on COMPRESSED bytes and rejects a
+    budget that cannot hold two decoded + two compressed shards,
+    naming both numbers;
+  * the Pallas q16 decode kernel matches the pure-XLA oracle bitwise;
+  * compression_ratio >= 3 on a snapped scengen tape (the committed
+    bench row pins >= 3.0 at 229376 bars; this is the fast proxy).
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.data import compress as C
+from gymfx_tpu.data.feed import (
+    BarStreamer,
+    MarketDataset,
+    market_data_nbytes,
+    shard_market_data,
+)
+from gymfx_tpu.scengen.feed import ScenGenDataset
+from tests.helpers import make_df
+
+WINDOW = 16
+TICK = 1e-5
+
+
+@functools.lru_cache(maxsize=4)
+def _scengen_host(n_bars=2048, **over):
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update(feed="scengen", scengen_preset="regime_mix",
+               scengen_bars=n_bars, scengen_seed=0,
+               scengen_snap_to_tick=True, window_size=WINDOW)
+    cfg.update(dict(over))
+    return ScenGenDataset(cfg).build_market_data(
+        window_size=WINDOW, device=False
+    )
+
+
+def _assert_bitwise(got, want, what=""):
+    la, lb = jax.tree.leaves(got), jax.tree.leaves(want)
+    assert len(la) == len(lb), what
+    for a, b in zip(la, lb):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype and a.shape == b.shape, what
+        assert a.tobytes() == b.tobytes(), what
+
+
+# ---------------------------------------------------------------------------
+# codec fits
+
+
+def test_validate_compress_mode():
+    assert C.validate_compress_mode(None) == "off"
+    assert C.validate_compress_mode("ON") == "on"
+    with pytest.raises(ValueError, match="data_compress must be one of"):
+        C.validate_compress_mode("zstd")
+
+
+def test_try_q16_roundtrip():
+    px = np.round((1.1 + TICK * np.arange(64, dtype=np.float64)) / TICK) * TICK
+    col = px.astype(np.float32).reshape(2, 32)
+    fit = C._try_q16(col, 1.0 / TICK)
+    assert fit is not None
+    base, delta = fit
+    assert base.dtype == np.int32 and delta.dtype == np.int16
+    dec = (base[:, None] + delta.astype(np.int32)).astype(np.float32)
+    dec = dec / np.float32(1.0 / TICK)
+    assert dec.tobytes() == col.tobytes()
+
+
+def test_try_q16_rejects_offgrid_and_wide_span():
+    off = np.array([[1.0, 1.0 + 0.37 * TICK]], np.float32)
+    assert C._try_q16(off, 1.0 / TICK) is None
+    wide = np.array([[1.0, 1.0 + 70000 * TICK]], np.float32)
+    assert C._try_q16(wide, 1.0 / TICK) is None
+
+
+def test_try_i16_and_u8():
+    narrow = (np.arange(40, dtype=np.int64) % 7 + 100).reshape(2, 20)
+    for fn, span in ((C._try_u8, 255), (C._try_i16, C._I16_SPAN)):
+        fit = fn(narrow.astype(np.int32))
+        assert fit is not None
+        base, delta = fit
+        assert np.array_equal(base[:, None] + delta.astype(np.int64), narrow)
+        too_wide = narrow.copy()
+        too_wide[0, 0] = narrow[0, 1] + span + 1
+        assert fn(too_wide.astype(np.int32)) is None
+
+
+def test_try_index_periodic():
+    period = 7
+    table = (np.arange(period, dtype=np.int32) * 3).astype(np.int32)
+    gidx = np.arange(40, dtype=np.int64).reshape(2, 20)
+    col = table[(gidx % period)]
+    got = C._try_index_periodic(col, gidx, period)
+    assert got is not None and np.array_equal(got, table)
+    # inconsistent slots (same index mod period, different value) reject
+    bad = col.copy()
+    bad[0, 0] = bad[0, 0] + 1
+    assert C._try_index_periodic(bad, gidx, period) is None
+    # size guard: a table as large as the data it replaces is not a win
+    assert C._try_index_periodic(col, gidx, 100) is None
+
+
+def test_try_periodic():
+    tab = (np.arange(120, dtype=np.float64) * 0.5).astype(np.float32)
+    minutes = (np.arange(600, dtype=np.int64) % 120).reshape(2, 300)
+    col = tab[minutes]
+    got = C._try_periodic(col, minutes)
+    assert got is not None and got.tobytes() == tab.tobytes()
+    # size guard: short tapes keep the q16 slab
+    assert C._try_periodic(col[:, :50], minutes[:, :50]) is None
+
+
+# ---------------------------------------------------------------------------
+# whole-tape encode/decode
+
+
+def test_encode_tape_roundtrip_bitwise_and_ratio():
+    host = _scengen_host()
+    tape = C.encode_tape(host, window_size=WINDOW, tick_size=TICK)
+    assert tape.num_shards == 1
+    assert tape.compression_ratio >= 3.0, tape.compression_ratio
+    rep = tape.codec_report()
+    assert rep["close"] == "q16" and rep["padded_close"] == "q16"
+    dec = C.decode_shard_ref(tape, 0)
+    want = shard_market_data(host, 0, tape.shard_bars, WINDOW)
+    _assert_bitwise(dec, want, "whole-tape decode")
+
+
+def test_offgrid_price_rejects_loudly():
+    closes = np.full(64, 1.1)
+    closes[37] = 1.1 + 0.37 * TICK  # off the tick grid
+    cfg = dict(DEFAULT_VALUES, window_size=8)
+    host = MarketDataset(make_df(closes), cfg).build_market_data(
+        window_size=8, device=False
+    )
+    with pytest.raises(ValueError, match="tick grid"):
+        C.encode_tape(host, window_size=8, tick_size=TICK)
+
+
+def test_price_span_beyond_int16_rejects_loudly():
+    # 400 ticks/bar * 200 bars = 80000 ticks — beyond the int16 delta
+    closes = np.round((1.0 + 400 * TICK * np.arange(200)) / TICK) * TICK
+    cfg = dict(DEFAULT_VALUES, window_size=8)
+    host = MarketDataset(make_df(closes), cfg).build_market_data(
+        window_size=8, device=False
+    )
+    with pytest.raises(ValueError, match="spans more than"):
+        C.encode_tape(host, window_size=8, tick_size=TICK)
+
+
+# ---------------------------------------------------------------------------
+# streamed shards: bit-identity at every shard, both decode modes
+
+
+@pytest.mark.parametrize("mode", ["interpret", "on"])
+def test_streamer_multishard_bit_identity(mode):
+    host = _scengen_host()
+    total = market_data_nbytes(host)
+    budget_mb = total / 4 / 2**20
+    bs = BarStreamer(host, window_size=WINDOW, budget_mb=budget_mb,
+                     compress=mode, tick_size=TICK)
+    assert bs.num_shards >= 3
+    assert bs.compression_ratio and bs.compression_ratio >= 3.0
+    # the remainder shard is anchored so its lookahead row is the last
+    # bar (same static shape as every other shard)
+    assert bs.starts[-1] == bs.n_bars - bs.shard_bars - 1
+    for k in range(bs.num_shards):
+        got = bs._device_shard(k)
+        assert int(np.asarray(got.row0)) == bs.starts[k]
+        want = shard_market_data(host, bs.starts[k], bs.shard_bars, WINDOW)
+        _assert_bitwise(got, want, f"mode={mode} shard {k}")
+
+
+def test_streamer_resident_tape_path():
+    host = _scengen_host()
+    total = market_data_nbytes(host)
+    bs = BarStreamer(host, window_size=WINDOW, budget_mb=total / 2**20,
+                     compress="interpret", tick_size=TICK)
+    # the whole compressed tape fits the ring: parked on device, no host
+    # f32 reference retained
+    assert bs.tape_resident and bs.host_data is None
+    assert bs.resident_bars == bs.num_shards * bs.shard_bars
+    got = bs._device_shard(bs.num_shards - 1)
+    want = shard_market_data(
+        host, bs.starts[-1], bs.shard_bars, WINDOW
+    )
+    _assert_bitwise(got, want, "resident tape decode")
+
+
+def test_planner_rejects_budget_naming_both_numbers():
+    host = _scengen_host()
+    per_bar = market_data_nbytes(host) / 2048
+    with pytest.raises(ValueError) as ei:
+        BarStreamer(host, window_size=WINDOW,
+                    budget_mb=150 * per_bar / 2**20,
+                    compress="interpret", tick_size=TICK)
+    msg = str(ei.value)
+    assert "cannot hold two" in msg
+    assert "decoded shards" in msg and "total compressed" in msg
+
+
+def test_nbytes_report_split():
+    host = _scengen_host()
+    total = market_data_nbytes(host)
+    bs = BarStreamer(host, window_size=WINDOW, budget_mb=total / 4 / 2**20,
+                     compress="interpret", tick_size=TICK)
+    rep = bs.nbytes_report()
+    assert rep["compressed"] == bs.tape.nbytes
+    assert rep["decoded"] == bs.tape.decoded_shard_nbytes * bs.num_shards
+    assert rep["ratio"] >= 3.0
+    # uncompressed streamer: split reports no compressed side
+    plain = BarStreamer(host, window_size=WINDOW,
+                        budget_mb=total / 4 / 2**20)
+    rep0 = plain.nbytes_report()
+    assert rep0["compressed"] is None and rep0["ratio"] is None
+
+
+# ---------------------------------------------------------------------------
+# periodic table codecs on real calendar columns
+
+
+def test_hourly_tape_uses_index_periodic_tables():
+    # H1 bars: 120 trading hours/week => a 120-slot table replaces the
+    # per-bar slab once the tape is longer than ~2 weeks; the start date
+    # keeps the whole tape inside ONE DST regime (DIVERGENCES.md) so the
+    # NY-calendar columns stay weekly-periodic
+    host = _scengen_host(timeframe="H1", scengen_start="2024-03-17")
+    tape = C.encode_tape(host, window_size=WINDOW, tick_size=TICK)
+    rep = tape.codec_report()
+    assert rep["minute_of_week"] == "iperiodic"
+    assert rep["calendar:0"] == "iperiodic"
+    assert tape.compression_ratio >= 4.5, tape.compression_ratio
+    _assert_bitwise(
+        C.decode_shard_ref(tape, 0),
+        shard_market_data(host, 0, tape.shard_bars, WINDOW),
+        "H1 iperiodic decode",
+    )
+
+
+def test_minute_of_week_periodic_fallback(monkeypatch):
+    # with the index-periodic codec disabled, weekly calendar columns
+    # fall back to the minute_of_week-gathered f32 table; the tape must
+    # be > 2 weeks of minute bars for the table to pay for itself
+    monkeypatch.setattr(C, "_try_index_periodic", lambda *a, **k: None)
+    host = _scengen_host(24576, scengen_start="2024-03-17")
+    tape = C.encode_tape(host, window_size=WINDOW, tick_size=TICK)
+    kinds = set(tape.codec_report().values())
+    assert "periodic" in kinds and "iperiodic" not in kinds
+    _assert_bitwise(
+        C.decode_shard_ref(tape, 0),
+        shard_market_data(host, 0, tape.shard_bars, WINDOW),
+        "minute-periodic decode",
+    )
+
+
+# ---------------------------------------------------------------------------
+# fused decode kernel parity
+
+
+def test_decode_q16_block_matches_ref():
+    from gymfx_tpu.ops.tape_decode import decode_q16_block
+
+    rng = np.random.default_rng(0)
+    for n_cols, rows in ((1, 7), (5, 300), (17, 2049)):
+        delta = rng.integers(-32768, 32768, size=(n_cols, rows))
+        delta = delta.astype(np.int16)
+        base = rng.integers(50000, 150000, size=(n_cols,)).astype(np.int32)
+        inv = np.asarray(
+            rng.choice([1.0, 60.0, 1e5], size=n_cols), np.float32
+        )
+        got = decode_q16_block(
+            jnp.asarray(delta), jnp.asarray(base), jnp.asarray(inv),
+            interpret=True,
+        )
+        want = C.decode_q16_ref(
+            jnp.asarray(delta), jnp.asarray(base), jnp.asarray(inv)
+        )
+        assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Environment wiring
+
+
+def test_environment_streams_compressed():
+    from gymfx_tpu.core.runtime import Environment
+
+    host = _scengen_host()
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update(feed="scengen", scengen_preset="regime_mix",
+               scengen_bars=2048, scengen_seed=0,
+               scengen_snap_to_tick=True, window_size=WINDOW,
+               stream_hbm_budget_mb=market_data_nbytes(host) / 4 / 2**20,
+               data_compress="interpret")
+    env = Environment(cfg)
+    assert env.streaming and env.streamer.tape is not None
+    # compressed mode never holds the f32 tape host-side
+    assert env.host_data is None and env.data is None
+    assert env.streamer.compression_ratio >= 3.0
+
+
+def test_environment_rejects_bad_compress_knob():
+    from gymfx_tpu.core.runtime import Environment
+
+    cfg = dict(DEFAULT_VALUES)
+    cfg.update(feed="scengen", scengen_preset="trend_calm",
+               scengen_bars=128, window_size=8, data_compress="zstd")
+    with pytest.raises(ValueError, match="data_compress must be one of"):
+        Environment(cfg)

@@ -63,6 +63,43 @@ def test_streamed_rollout_bit_identical_to_resident(mode):
         )
 
 
+@pytest.mark.parametrize("mode", ["interpret", "on"])
+def test_compressed_streamed_rollout_bit_identical_to_resident(mode):
+    """Billion-bar data path: the SAME streamed-vs-resident bitwise
+    contract with data_compress on|interpret — shards ship as int16
+    tick-deltas and decode on device, so the rollout must not be able
+    to tell.  Prices must be on the tick grid (the codec's
+    honor-or-reject), hence the snapped ramp instead of uptrend_df."""
+    import jax
+
+    from gymfx_tpu.data.feed import market_data_nbytes
+    from tests.helpers import make_df
+
+    n = 400
+    closes = np.round((1.1 + 1e-5 * np.arange(n)) * 1e5) / 1e5
+    df = make_df(closes)
+    resident = make_env(df)
+    total = market_data_nbytes(resident.data)
+    streaming = make_env(df, stream_hbm_budget_mb=total / 2 / 2**20,
+                         data_compress=mode)
+    assert streaming.streaming and streaming.streamer.tape is not None
+    assert streaming.streamer.num_shards >= 3
+    driver = DRIVERS["buy_hold"]()
+    s_ref, out_ref = resident.rollout(driver, n - 1, seed=0)
+    s_str, out_str = streaming.rollout(driver, n - 1, seed=0)
+    for key in out_ref:
+        np.testing.assert_array_equal(
+            np.asarray(out_ref[key]), np.asarray(out_str[key]),
+            err_msg=f"outputs[{key}] ({mode})",
+        )
+    for i, (a, b) in enumerate(
+        zip(jax.tree.leaves(s_ref), jax.tree.leaves(s_str))
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"state leaf {i} ({mode})"
+        )
+
+
 def test_budget_large_enough_stays_resident_and_identical():
     import jax
 

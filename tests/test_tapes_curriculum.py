@@ -1,0 +1,292 @@
+"""Dataset-of-tapes registry + mixed curriculum sampler
+(gymfx_tpu/data/tapes.py, feed=curriculum).  Pinned here:
+
+  * the ``tapes`` grammar ('kind:source[@weight]' strings or JSON
+    dicts with per-tape overrides) is honor-or-reject: bad weights,
+    unknown kinds, duplicates and empty registries all raise;
+  * a single-tape curriculum trains BITWISE identical to plain
+    feed=scengen (tape 0 IS the environment's own dataset);
+  * a compressed tape library (data_compress=interpret) decodes each
+    pick bitwise identical to the uncompressed library;
+  * tape draws are seed-deterministic PCG64 — bitwise-stable across a
+    subprocess boundary — and every draw is ledgered as a
+    ``curriculum_pick`` row when a run ledger is active;
+  * invalid combinations reject loudly: unequal tape bar counts,
+    curriculum + shard streaming, curriculum + eval_split,
+    curriculum + superstep_overlap, portfolio + data_compress, and
+    portfolio 'file:' tapes without a portfolio_files override.
+"""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from gymfx_tpu.config import DEFAULT_VALUES
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data import tapes as tapes_mod
+
+REPO = Path(__file__).resolve().parents[1]
+
+BASE = dict(DEFAULT_VALUES)
+BASE.update({
+    "window_size": 8, "num_envs": 4, "ppo_horizon": 8,
+    "ppo_epochs": 1, "ppo_minibatches": 2,
+    "policy_kwargs": {"hidden": [32, 32]},
+    "seed": 7, "scengen_bars": 512, "scengen_seed": 3,
+    "scengen_snap_to_tick": True,
+})
+
+
+# ---------------------------------------------------------------------------
+# the tapes grammar
+
+
+def test_parse_tape_specs_string_grammar():
+    specs = tapes_mod.parse_tape_specs(
+        {"tapes": "scengen:flash_crash@2,scengen:range_chop"}
+    )
+    assert [s.label for s in specs] == [
+        "scengen:flash_crash", "scengen:range_chop"
+    ]
+    assert [s.weight for s in specs] == [2.0, 1.0]
+    assert specs[0].kind == "scengen" and specs[0].source == "flash_crash"
+
+
+def test_parse_tape_specs_json_dicts_with_overrides():
+    raw = json.dumps([
+        {"scengen": "trend_calm", "weight": 3},
+        {"file": "/data/eurusd.csv", "weight": 1, "max_rows": 5000},
+    ])
+    specs = tapes_mod.parse_tape_specs({"tapes": raw})
+    assert specs[0].weight == 3.0 and specs[1].kind == "file"
+    assert dict(specs[1].overrides) == {"max_rows": 5000}
+    overlay = tapes_mod.overlay_config(dict(BASE, tapes=raw), specs[1])
+    assert overlay["feed"] == "replay"
+    assert overlay["input_data_file"] == "/data/eurusd.csv"
+    assert overlay["max_rows"] == 5000 and "tapes" not in overlay
+
+
+@pytest.mark.parametrize("bad,match", [
+    (None, "requires the 'tapes'"),
+    ("", "requires the 'tapes'"),
+    ("scengen:x@abc", "must be a number"),
+    ("scengen:x@0", "finite positive"),
+    ("nocolon", "must look like"),
+    ("replay:x", "must look like"),
+    ("scengen:x,scengen:x", "more than once"),
+    ('[{"scengen": "a", "file": "b"}]', "exactly one of"),
+    ("[not json", "does not parse"),
+])
+def test_parse_tape_specs_rejects(bad, match):
+    with pytest.raises(ValueError, match=match):
+        tapes_mod.parse_tape_specs({"tapes": bad})
+
+
+# ---------------------------------------------------------------------------
+# seed-deterministic draws + ledgered picks
+
+
+class _DummyPicker(tapes_mod._TapePickerBase):
+    def __init__(self, config, specs):
+        self._init_picker(config, specs)
+
+    def _tape_data(self, i):
+        return None
+
+
+_PICK_SPECS = "scengen:flash_crash@3,scengen:range_chop@1"
+
+
+def _pick_sequence(seed, n=16):
+    p = _DummyPicker({"curriculum_seed": seed},
+                     tapes_mod.parse_tape_specs({"tapes": _PICK_SPECS}))
+    return [p.pick(i)[0] for i in range(n)]
+
+
+def test_pick_determinism_across_subprocess():
+    script = (
+        "import json\n"
+        "from gymfx_tpu.data import tapes as T\n"
+        "class P(T._TapePickerBase):\n"
+        "    def __init__(self, c, s): self._init_picker(c, s)\n"
+        "    def _tape_data(self, i): return None\n"
+        f"specs = T.parse_tape_specs({{'tapes': {_PICK_SPECS!r}}})\n"
+        "p = P({'curriculum_seed': 11}, specs)\n"
+        "print(json.dumps([p.pick(i)[0] for i in range(16)]))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", script], cwd=str(REPO), env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    child = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert child == _pick_sequence(11)
+    # the draws actually mix both tapes and honor the seed
+    assert set(child) == {0, 1}
+    assert _pick_sequence(12) != child
+
+
+def test_pick_rows_ledgered(tmp_path):
+    from gymfx_tpu.telemetry.ledger import (
+        RunLedger,
+        read_ledger,
+        set_active_ledger,
+    )
+
+    path = str(tmp_path / "ledger.jsonl")
+    ledger = RunLedger(path)
+    set_active_ledger(ledger)
+    try:
+        picks = _pick_sequence(5, n=6)
+    finally:
+        set_active_ledger(None)
+    rows = [r for r in read_ledger(path) if r.get("kind") == "curriculum_pick"]
+    assert len(rows) == 6
+    assert [r["tape_index"] for r in rows] == picks
+    assert [r["it_start"] for r in rows] == list(range(6))
+    assert all(r["seed"] == 5 for r in rows)
+    assert rows[0]["tape"] in ("scengen:flash_crash", "scengen:range_chop")
+
+
+# ---------------------------------------------------------------------------
+# curriculum training: bitwise contracts
+
+
+def _train_leaves(cfg, iters=2):
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    env = Environment(dict(cfg))
+    tr = PPOTrainer(env, ppo_config_from(env.config))
+    state = tr.init_state(0)
+    if tr.curriculum is not None:
+        for it in range(iters):
+            _i, _label, tape = tr.curriculum.pick(it)
+            state, _ = tr._train_step_data(state, tape)
+    else:
+        for _ in range(iters):
+            state, _ = tr.train_step(state)
+    return [np.asarray(x) for x in jax.tree.leaves(state.params)]
+
+
+def test_single_tape_curriculum_bitwise_plain_scengen():
+    plain = _train_leaves(
+        dict(BASE, feed="scengen", scengen_preset="flash_crash")
+    )
+    curr = _train_leaves(
+        dict(BASE, feed="curriculum", tapes="scengen:flash_crash")
+    )
+    assert all(
+        a.tobytes() == b.tobytes() for a, b in zip(plain, curr)
+    ), "single-tape curriculum must be bitwise plain scengen"
+
+
+def test_compressed_tape_library_bitwise_and_smaller():
+    two = dict(BASE, feed="curriculum",
+               tapes="scengen:flash_crash@2,scengen:range_chop@1")
+    env_u = Environment(dict(two))
+    env_c = Environment(dict(two, data_compress="interpret"))
+    for i in range(env_u.curriculum.num_tapes):
+        lu = jax.tree.leaves(env_u.curriculum._tape_data(i))
+        lc = jax.tree.leaves(env_c.curriculum._tape_data(i))
+        for a, b in zip(lu, lc):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), i
+    rep = env_c.curriculum.nbytes_report()
+    assert rep["compressed"] and rep["ratio"] >= 3.0, rep
+    assert env_u.curriculum.nbytes_report()["compressed"] is None
+
+
+# ---------------------------------------------------------------------------
+# invalid combinations reject loudly
+
+
+def test_unequal_tape_bar_counts_reject():
+    raw = json.dumps([
+        {"scengen": "flash_crash"},
+        {"scengen": "range_chop", "scengen_bars": 256},
+    ])
+    with pytest.raises(ValueError, match="same bar count"):
+        Environment(dict(BASE, feed="curriculum", tapes=raw))
+
+
+def test_curriculum_rejects_shard_streaming():
+    cfg = dict(BASE, feed="curriculum", tapes="scengen:flash_crash",
+               stream_hbm_budget_mb=0.01)
+    with pytest.raises(ValueError, match="shard streaming"):
+        Environment(cfg)
+
+
+def test_curriculum_rejects_eval_split():
+    from gymfx_tpu.train.common import build_train_eval_envs
+
+    cfg = dict(BASE, feed="curriculum", tapes="scengen:flash_crash",
+               eval_split=0.25)
+    with pytest.raises(ValueError, match="eval_split"):
+        build_train_eval_envs(cfg)
+
+
+def test_curriculum_rejects_superstep_overlap():
+    from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+    cfg = dict(BASE, feed="curriculum", tapes="scengen:flash_crash",
+               superstep_overlap=True)
+    env = Environment(cfg)
+    with pytest.raises(ValueError, match="superstep_overlap"):
+        PPOTrainer(env, ppo_config_from(env.config))
+
+
+# ---------------------------------------------------------------------------
+# portfolio curriculum
+
+
+def test_portfolio_env_rejects_data_compress():
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+
+    with pytest.raises(ValueError, match="no compressed form"):
+        PortfolioEnvironment({
+            "feed": "scengen", "scengen_preset": "multi_asset_calm",
+            "scengen_bars": 96, "window_size": 8,
+            "data_compress": "interpret",
+        })
+
+
+def test_portfolio_curriculum_file_tape_needs_book_override():
+    specs = tapes_mod.parse_tape_specs({
+        "tapes": json.dumps([
+            {"scengen": "multi_asset_calm"},
+            {"file": "/data/eurusd.csv"},
+        ])
+    })
+    base_env = SimpleNamespace(cfg=SimpleNamespace(n_bars=96), data=None)
+    with pytest.raises(ValueError, match="portfolio_files"):
+        tapes_mod.PortfolioCurriculumSampler({}, specs, base_env=base_env)
+
+
+def test_portfolio_curriculum_scengen_books():
+    from gymfx_tpu.core.portfolio import PortfolioEnvironment
+
+    env = PortfolioEnvironment({
+        "feed": "curriculum",
+        "tapes": "scengen:multi_asset_calm@2,scengen:multi_asset_stress@1",
+        "scengen_bars": 96, "scengen_seed": 4,
+        "window_size": 8, "initial_cash": 10000.0,
+    })
+    assert env.curriculum is not None and env.curriculum.num_tapes == 2
+    base_close = np.asarray(env.data.pair.close)
+    for i in range(2):
+        data_i = env.curriculum._tape_data(i)
+        close_i = np.asarray(data_i.pair.close)
+        assert close_i.shape == base_close.shape
+    # tape 0 IS the env's own book
+    assert env.curriculum._tape_data(0) is env.data
+    i, label, data = env.curriculum.pick(0)
+    assert label.startswith("scengen:multi_asset")
+    assert np.asarray(data.pair.close).shape == base_close.shape

@@ -40,6 +40,12 @@ from check_bench_contract import load_schema, validate_record  # noqa: E402
 DEFAULT_THRESHOLD = 0.2
 _ROUND_RE = re.compile(r"r(\d+)", re.IGNORECASE)
 
+# platform-INDEPENDENT auxiliary metrics: the compression codec's ratio
+# and resident-bar capacity depend only on the tape and the wire format,
+# not the accelerator, so their trajectory is gated even on rows that
+# are not throughput-comparable (cpu proxies, declared_non_comparable)
+AUX_METRICS = ("data_compression_ratio", "resident_bars")
+
 
 def _round_of(path: Path, wrapper: Dict[str, Any]) -> int:
     n = wrapper.get("n")
@@ -156,11 +162,45 @@ def sentinel_report(
                     f"best previous {best_prev:.6g} "
                     f"(threshold {100 * threshold:.0f}%)"
                 )
+    # auxiliary platform-independent trajectories: any cleanly parsed
+    # row (rc==0) contributes when it carries the key non-null, even if
+    # its throughput value is not comparable across hardware
+    aux: Dict[str, Dict[str, Any]] = {}
+    for row in rows:
+        record = row.get("record")
+        if not isinstance(record, dict) or str(row["why"]).startswith("rc="):
+            continue
+        for key in AUX_METRICS:
+            value = record.get(key)
+            if isinstance(value, (int, float)) and not isinstance(
+                    value, bool):
+                aux.setdefault(key, {"points": []})["points"].append(
+                    {"file": row["file"], "round": row["round"],
+                     "value": float(value)}
+                )
+    for key, data in aux.items():
+        points = data["points"]
+        latest = points[-1]
+        best_prev = max((p["value"] for p in points[:-1]), default=None)
+        data["latest"] = latest
+        data["best_previous"] = best_prev
+        if best_prev is not None and best_prev > 0:
+            ratio = latest["value"] / best_prev
+            data["vs_best_previous"] = round(ratio, 4)
+            if ratio < 1.0 - threshold:
+                regressions.append(
+                    f"{key}: latest {latest['value']:.6g} "
+                    f"({latest['file']}) is {100 * (1 - ratio):.1f}% below "
+                    f"best previous {best_prev:.6g} "
+                    f"(threshold {100 * threshold:.0f}%)"
+                )
+
     ok = not regressions and not drift
     return {
         "ok": ok,
         "threshold": threshold,
         "metrics": metrics,
+        "aux_metrics": aux,
         "skipped": skipped,
         "regressions": regressions,
         "schema_drift": drift,
@@ -255,6 +295,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         for metric, data in sorted(report["metrics"].items()):
             latest = data["latest"]
             line = (f"  {metric}: latest {latest['value']:.6g} "
+                    f"({latest['file']})")
+            if data.get("best_previous") is not None:
+                line += (f", best previous {data['best_previous']:.6g}"
+                         f", ratio {data.get('vs_best_previous')}")
+            print(line)
+        for key, data in sorted(report.get("aux_metrics", {}).items()):
+            latest = data["latest"]
+            line = (f"  aux {key}: latest {latest['value']:.6g} "
                     f"({latest['file']})")
             if data.get("best_previous") is not None:
                 line += (f", best previous {data['best_previous']:.6g}"

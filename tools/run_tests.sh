@@ -53,16 +53,110 @@ env JAX_PLATFORMS=cpu python tools/scenario_gate.py --quick \
     > /dev/null || gate_rc=$?
 echo "scenario gate (quick): rc=$gate_rc"
 
-# r10 MFU push: bench contract smoke with the fused env-dynamics
-# kernels in pallas interpret mode — exercises the kernel path on CPU
-# CI and pins the row (incl. overlap_ms_saved / update_gemm_frac /
-# mfu_analytic) against tools/bench_contract_schema.json
+# r10 MFU push + billion-bar data path: bench contract smoke with the
+# fused env-dynamics kernels AND the compressed stream probe in pallas
+# interpret mode — exercises both kernel paths on CPU CI and pins the
+# row (incl. overlap_ms_saved / update_gemm_frac / mfu_analytic /
+# stream_bars_per_sec / data_compression_ratio / resident_bars)
+# against tools/bench_contract_schema.json; the codec must hold
+# ratio >= 3 and a real resident-bars win even at the --quick tape size
+bench_row=$(mktemp)
 bench_rc=0
 env JAX_PLATFORMS=cpu python bench.py --quick \
-        --rollout_env_kernel interpret \
+        --rollout_env_kernel interpret --data_compress interpret \
+    | tee "$bench_row" \
     | env JAX_PLATFORMS=cpu python tools/check_bench_contract.py \
     || bench_rc=$?
-echo "bench contract (quick, rollout_env_kernel=interpret): rc=$bench_rc"
+if [ "$bench_rc" -eq 0 ]; then
+    python - "$bench_row" <<'EOF' || bench_rc=$?
+import json
+import sys
+
+row = json.loads(
+    [ln for ln in open(sys.argv[1], encoding="utf-8") if ln.strip()][-1]
+)
+assert row["stream_bars_per_sec"] > 0, row
+assert row["data_compression_ratio"] >= 3.0, row["data_compression_ratio"]
+assert row["resident_bars"] > 2 * row["resident_bars_uncompressed"], row
+print(f"stream probe OK (ratio {row['data_compression_ratio']}, "
+      f"{row['resident_bars']} resident bars vs "
+      f"{row['resident_bars_uncompressed']} uncompressed at "
+      f"{row['stream_hbm_budget_mb']} MiB)")
+EOF
+fi
+rm -f "$bench_row"
+echo "bench contract (quick, env kernel + stream probe): rc=$bench_rc"
+
+# billion-bar data path: a 2-superstep compressed training run
+# (interpret decode kernel) must be BITWISE identical to the
+# uncompressed path — (a) curriculum training over a compressed tape
+# library vs the same library uncompressed, (b) a compressed streamed
+# rollout vs the fully-resident tape
+stream_rc=0
+env JAX_PLATFORMS=cpu python - <<'EOF' || stream_rc=$?
+import numpy as np
+
+import jax
+
+from gymfx_tpu.config.defaults import DEFAULT_VALUES
+from gymfx_tpu.core.rollout import DRIVERS
+from gymfx_tpu.core.runtime import Environment
+from gymfx_tpu.data.feed import market_data_nbytes
+from gymfx_tpu.train.ppo import PPOTrainer, ppo_config_from
+
+BASE = dict(DEFAULT_VALUES)
+BASE.update({
+    "window_size": 8, "num_envs": 4, "ppo_horizon": 8,
+    "ppo_epochs": 1, "ppo_minibatches": 2,
+    "policy_kwargs": {"hidden": [16, 16]}, "seed": 1,
+    "feed": "curriculum",
+    "tapes": "scengen:flash_crash@2,scengen:range_chop@1",
+    "scengen_bars": 512, "scengen_seed": 3,
+    "scengen_snap_to_tick": True,
+})
+
+
+def train(compress):
+    env = Environment(dict(BASE, data_compress=compress))
+    tr = PPOTrainer(env, ppo_config_from(env.config))
+    state = tr.init_state(0)
+    for it in range(2):  # 2 supersteps, tape swap at each boundary
+        _i, _label, tape = tr.curriculum.pick(it)
+        state, _ = tr._train_step_data(state, tape)
+    return [np.asarray(x) for x in jax.tree.leaves(state.params)]
+
+
+ref, got = train("off"), train("interpret")
+assert all(a.tobytes() == b.tobytes() for a, b in zip(ref, got)), \
+    "compressed curriculum training diverged from the uncompressed path"
+print("compressed curriculum training bitwise OK (2 supersteps)")
+
+scfg = dict(DEFAULT_VALUES)
+scfg.update({
+    "feed": "scengen", "scengen_preset": "regime_mix",
+    "scengen_bars": 2048, "scengen_seed": 0,
+    "scengen_snap_to_tick": True, "window_size": 16,
+})
+resident = Environment(dict(scfg))
+total = market_data_nbytes(resident.data)
+streamed = Environment(dict(
+    scfg, stream_hbm_budget_mb=total / 4 / 2**20,
+    data_compress="interpret",
+))
+assert streamed.streaming and streamed.streamer.num_shards >= 3
+driver = DRIVERS["buy_hold"]()
+s_ref, out_ref = resident.rollout(driver, 2047, seed=0)
+s_str, out_str = streamed.rollout(driver, 2047, seed=0)
+for key in out_ref:
+    a, b = np.asarray(out_ref[key]), np.asarray(out_str[key])
+    assert a.tobytes() == b.tobytes(), f"outputs[{key}]"
+for a, b in zip(jax.tree.leaves(s_ref), jax.tree.leaves(s_str)):
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), "state"
+print(f"compressed streamed rollout bitwise OK "
+      f"({streamed.streamer.num_shards} shards, ratio "
+      f"{streamed.streamer.compression_ratio:.2f})")
+EOF
+echo "compressed data path (training + stream parity): rc=$stream_rc"
 
 # bench-regression sentinel: the committed BENCH_r*/MULTICHIP_r* rows
 # must keep a healthy trajectory (explicitly non-comparable rows are
@@ -349,6 +443,9 @@ if [ "$gate_rc" -ne 0 ]; then
 fi
 if [ "$bench_rc" -ne 0 ]; then
     exit "$bench_rc"
+fi
+if [ "$stream_rc" -ne 0 ]; then
+    exit "$stream_rc"
 fi
 if [ "$sentinel_rc" -ne 0 ]; then
     exit "$sentinel_rc"
