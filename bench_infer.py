@@ -54,6 +54,10 @@ def main() -> None:
     ap.add_argument("--fault_profile", default="",
                     help="overload-phase fault profile (default: the "
                          "scripted burst-overload scenario)")
+    ap.add_argument("--session_slots", type=int, default=0,
+                    help="A/B the device-resident slot-cache serve path "
+                         "against host-carry at the same batch size "
+                         "(recurrent policies only; 0 = off)")
     ap.add_argument("--quick", action="store_true", help="small shapes (CI)")
     args = ap.parse_args()
     buckets = None
@@ -133,6 +137,63 @@ def main() -> None:
     for _ in range(args.iters):
         engine.decide_batch(rows, carries)
     batched_per_sec = args.batch * args.iters / (time.perf_counter() - t0)
+
+    # --- device-resident slot cache A/B (docs/serving.md) ---------------
+    # same engine, same rows, same batch width: host-carry loop (carry
+    # crosses the host boundary both ways every dispatch) vs slot loop
+    # (carry lives in device slots; only the one-dispatch-late mirror is
+    # fetched).  Keys are ALWAYS emitted — null when the mode is off or
+    # the policy has no carry to cache.
+    slot_keys = {
+        "session_slots": None,
+        "slot_decisions_per_sec": None,
+        "carry_transfer_bytes_per_decision": None,
+        "carry_transfer_bytes_per_decision_host": None,
+        "speedup_vs_host_carry": None,
+    }
+    if args.session_slots > 0 and engine.recurrent:
+        n_slot = min(args.batch, int(engine.buckets[-1]), args.session_slots)
+        slot_rows = rows[:n_slot]
+        sessions = [f"bench-{i}" for i in range(n_slot)]
+        engine.enable_slots(args.session_slots)
+        # host-carry side at the SAME width (the headline above may run
+        # a different batch): thread the returned carry like a real
+        # session stream so every dispatch pays the round trip
+        hc = engine.initial_carry_batch(n_slot)
+        d = engine.decide_batch(slot_rows, hc)  # touch once before timing
+        t0 = time.perf_counter()
+        hc = d.carry
+        for _ in range(args.iters):
+            hc = engine.decide_batch(slot_rows, hc).carry
+        host_per_sec = n_slot * args.iters / (time.perf_counter() - t0)
+        # slot side: first call assigns + compiles nothing new (warmup
+        # built the ladder), later calls are pure gather->fwd->scatter
+        engine.decide_batch_slots(slot_rows, sessions)
+        dec0 = engine.slot_decisions
+        bytes0 = engine.mirror_fetch_bytes
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            engine.decide_batch_slots(slot_rows, sessions)
+        slot_per_sec = n_slot * args.iters / (time.perf_counter() - t0)
+        slot_decs = max(1, engine.slot_decisions - dec0)
+        mirror_bytes = engine.mirror_fetch_bytes - bytes0
+        # analytic host-path cost: the full carry pytree crosses the
+        # boundary down AND up once per decision
+        carry_bytes = sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(engine.initial_carry())
+        )
+        slot_keys = {
+            "session_slots": args.session_slots,
+            "slot_decisions_per_sec": round(slot_per_sec, 1),
+            "carry_transfer_bytes_per_decision": round(
+                mirror_bytes / slot_decs, 1
+            ),
+            "carry_transfer_bytes_per_decision_host": float(2 * carry_bytes),
+            "speedup_vs_host_carry": round(
+                slot_per_sec / max(host_per_sec, 1e-9), 2
+            ),
+        }
 
     # --- micro-batched request latency ----------------------------------
     import threading
@@ -280,6 +341,9 @@ def main() -> None:
                 "mean_coalesced_per_dispatch": round(coalesce, 1),
                 "late_compiles": engine.late_compiles,
                 "boot_compile_s": round(boot_s, 2),
+                # device-resident slot-cache A/B (null when off or the
+                # policy carries no recurrent state)
+                **slot_keys,
                 "latency_throughput_per_sec": round(
                     len(records) / lat_wall, 1
                 ),

@@ -146,6 +146,16 @@ def parse_args(argv=None):
     parser.add_argument("--serve_breaker_recovery_s", type=float)
     parser.add_argument("--feed_stale_after_s", type=float)
 
+    # device-resident sessions (docs/serving.md, "Device-resident
+    # sessions"); 0 slots = the host-carry serving path
+    parser.add_argument("--serve_session_slots", type=int)
+    parser.add_argument(
+        "--serve_slot_mirror", action="store_true", default=None
+    )
+    parser.add_argument(
+        "--serve_staging", action="store_true", default=None
+    )
+
     # telemetry (docs/observability.md); all off unless set
     parser.add_argument(
         "--telemetry_enabled", action="store_true", default=None

@@ -237,6 +237,21 @@ DEFAULT_VALUES = {
     # exceeds this many seconds, PolicyDecisionService decides via the
     # fallback policy instead of acting on a stale window.  null = off
     "feed_stale_after_s": None,
+    # ---- device-resident sessions (docs/serving.md, "Device-resident
+    # sessions") — recurrent session carry cached in pre-allocated
+    # device slot arrays; each dispatch passes only slot indices + obs
+    # through a fused gather->policy->scatter program (zero per-decision
+    # carry transfers).  0 keeps the host-carry serving path bitwise
+    # identical to the pre-slot code.
+    "serve_session_slots": 0,
+    # one-dispatch-late host mirror of dirty slots: the failover /
+    # blue-green carry-handoff contract.  Only read with slots enabled
+    "serve_slot_mirror": True,
+    # pipelined batch assembly: the micro-batcher fills double-buffered
+    # host staging while the previous batch's executable runs, and
+    # resolves batch N only after batch N+1 is dispatched.  Only
+    # engages with serve_session_slots > 0
+    "serve_staging": True,
     # ---- continuous deployment (docs/serving.md, "Hot-swap and
     # blue/green"; docs/resilience.md) — only read when a
     # BlueGreenDeployer / deploy controller is constructed; a plain

@@ -179,16 +179,18 @@ class FlakyEngine:
             return self._rng.choice(self._rate_tokens)
         return "ok"
 
-    def decide_batch(self, obs_batch: Any, carries: Any = None):
+    def _consume_token(self) -> None:
+        """One fault decision per dispatch, shared by every intercepted
+        dispatch surface (sync, slot, async)."""
         self.dispatch_calls += 1
         token = self._next_token()
         self.history.append(token)
         if token == "ok":
-            return self._inner.decide_batch(obs_batch, carries)
+            return
         self.faults_injected += 1
         if token.startswith(("slow:", "stall:")):
             self._sleep(float(token.split(":", 1)[1]) / 1e3)
-            return self._inner.decide_batch(obs_batch, carries)
+            return
         if token == "exc":
             raise InjectedDispatchError(
                 "injected engine dispatch failure"
@@ -196,6 +198,27 @@ class FlakyEngine:
         raise ValueError(
             f"unknown serve fault token {token!r}; known: {SERVE_FAULT_TOKENS}"
         )
+
+    def decide_batch(self, obs_batch: Any, carries: Any = None):
+        self._consume_token()
+        return self._inner.decide_batch(obs_batch, carries)
+
+    # the slot-cache / pipelined dispatch surfaces (serve/slots.py,
+    # docs/serving.md "Device-resident sessions") are class-defined on
+    # InferenceEngine, so __getattr__ delegation alone would bypass
+    # fault injection — intercept them explicitly.  Faults inject at
+    # DISPATCH time (matching the sync path); a resolve() of an already
+    # issued handle is never failed by the wrapper.
+    def dispatch_async(self, obs_batch: Any, carries: Any = None, **kwargs):
+        self._consume_token()
+        return self._inner.dispatch_async(obs_batch, carries, **kwargs)
+
+    def decide_batch_slots(
+        self, obs_batch: Any, sessions: Any, seed_carries: Any = None
+    ):
+        return self.dispatch_async(
+            obs_batch, sessions=sessions, seed_carries=seed_carries
+        ).resolve()
 
     def decide(self, obs_vec: Any, carry: Any = None):
         """Single-request convenience routed through the FAULTED

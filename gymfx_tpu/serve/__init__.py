@@ -47,11 +47,13 @@ from gymfx_tpu.serve.engine import (
     DEFAULT_BUCKETS,
     Decision,
     EngineBundle,
+    EngineDispatch,
     InferenceEngine,
     WeightSwapError,
     engine_from_config,
     resolve_batch_mode,
 )
+from gymfx_tpu.serve.slots import SlotCache
 from gymfx_tpu.serve.features import (
     BarFeaturizer,
     BarSession,
@@ -73,6 +75,7 @@ __all__ = [
     "DeployError",
     "DrainWhilePausedError",
     "EngineBundle",
+    "EngineDispatch",
     "FleetBundle",
     "FleetConfig",
     "FleetError",
@@ -85,6 +88,7 @@ __all__ = [
     "ServeConfig",
     "SessionStateStore",
     "ShedError",
+    "SlotCache",
     "WeightSwapError",
     "batcher_from_config",
     "bluegreen_from_config",

@@ -80,6 +80,17 @@ class _Pending(NamedTuple):
     future: Future
     t_enqueue: float
     deadline: Optional[float]  # absolute perf_counter second, None = no deadline
+    session: Optional[str] = None  # slot-cache session id (serve/slots.py)
+
+
+class _Inflight(NamedTuple):
+    """One dispatched-but-unresolved micro-batch (pipelined worker)."""
+
+    handle: Any           # engine.EngineDispatch
+    batch: List[_Pending]
+    engine: Any
+    t_pickup: float
+    t_dispatch: float
 
 
 class MicroBatcher:
@@ -121,6 +132,7 @@ class MicroBatcher:
         default_deadline_ms: Optional[float] = None,
         breaker: Optional[Any] = None,
         instruments: Optional[Any] = None,
+        pipeline: bool = False,
     ):
         if max_batch_wait_ms < 0:
             raise ValueError(
@@ -154,6 +166,17 @@ class MicroBatcher:
         self.deadline_miss_count = 0
         self.dispatch_failures = 0
         self.breaker_open_count = 0
+        self.deferred_count = 0  # slot-mode rows requeued (duplicate
+        # session / capacity / mixed-style) — never dropped, never
+        # reordered within a session
+        # pipelined dispatch (serve_staging): the worker issues batch
+        # N+1 via engine.dispatch_async while batch N's executable is
+        # still running, resolving N only after N+1 is in flight —
+        # depth-1 double buffering, same discipline as data.BarStreamer
+        self.pipeline = bool(pipeline)
+        if self.pipeline:
+            # the async path never chunks — cap coalescing at the ladder
+            self.max_batch = min(self.max_batch, int(engine.buckets[-1]))
         self._inflight = 0
         self._closed = False
         self._draining = False
@@ -171,7 +194,9 @@ class MicroBatcher:
         if instruments is not None:
             instruments.bind_batcher(self)
         self._worker = threading.Thread(
-            target=self._run, name="gymfx-serve-batcher", daemon=True
+            target=self._run_pipelined if self.pipeline else self._run,
+            name="gymfx-serve-batcher",
+            daemon=True,
         )
         self._worker.start()
 
@@ -182,6 +207,7 @@ class MicroBatcher:
         carry: Any = None,
         *,
         deadline_ms: Optional[float] = None,
+        session: Optional[str] = None,
     ) -> Future:
         """Enqueue one encoded observation (engine input row); returns a
         Future of its Decision row.  ``carry`` is the session's
@@ -191,11 +217,28 @@ class MicroBatcher:
         ``default_deadline_ms``); a request whose deadline passes before
         dispatch fails with :class:`DeadlineExceeded`.
 
+        ``session`` is the slot-cache session id: with the engine's
+        device slot cache enabled the row's carry is gathered from /
+        scattered to the session's device slot (``carry``, if given, is
+        only the SEED for a session not yet resident — the failover
+        re-pin path — and the Decision row comes back with
+        ``carry=None`` because carry never left the device).  Without a
+        slot cache ``session`` is ignored and the host-carry semantics
+        above apply bitwise unchanged.
+
         Raises :class:`BatcherClosedError` after close()/drain(), and
         :class:`ShedError` when the queue is full under the ``reject``
         shed policy (under ``evict_oldest`` the OLDEST queued request's
         future fails instead and this one is admitted)."""
-        if self.engine.recurrent and carry is None:
+        if (
+            self.engine.recurrent
+            and carry is None
+            and getattr(self.engine, "slot_cache", None) is None
+        ):
+            # host-carry path: fresh sessions start from the initial
+            # carry, pre-filled here so the dispatch can stack blindly.
+            # In slot mode a None carry stays None — the device INITIAL
+            # row (sessionless) or the session's slot is authoritative.
             carry = self.engine.initial_carry()
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
@@ -206,6 +249,7 @@ class MicroBatcher:
             Future(),
             t_enqueue,
             None if deadline_ms is None else t_enqueue + float(deadline_ms) / 1e3,
+            None if session is None else str(session),
         )
         evicted: Optional[_Pending] = None
         with self._cv:
@@ -269,6 +313,8 @@ class MicroBatcher:
                 "deadline_miss_count": self.deadline_miss_count,
                 "dispatch_failures": self.dispatch_failures,
                 "breaker_open_failures": self.breaker_open_count,
+                "deferred_count": self.deferred_count,
+                "pipeline": self.pipeline,
                 "dispatches": self.dispatches,
                 "coalesced_total": self.coalesced_total,
                 "max_queue": self.max_queue,
@@ -505,12 +551,56 @@ class MicroBatcher:
                         self.deadline_miss_count += n_expired
                     if self._instr is not None:
                         self._instr.on_deadline_miss("dispatch", n_expired)
+                live = self._defer_conflicts(live)
                 if live:
                     self._dispatch(live, t_pickup)
             finally:
                 with self._cv:
                     self._inflight -= 1
                     self._cv.notify_all()
+
+    @staticmethod
+    def _slot_row(p: _Pending) -> bool:
+        # slot-eligible: has a session (slot/seed semantics) or carries
+        # nothing (computes from the device INITIAL row — bitwise the
+        # initial carry in exact mode).  A sessionless row with an
+        # explicit carry must ride the host path: slots cannot honor it.
+        return p.session is not None or p.carry is None
+
+    def _defer_conflicts(self, batch: List[_Pending]) -> List[_Pending]:
+        """Slot-mode batch admission: requeue (at the FRONT, order
+        preserved) rows that cannot share this dispatch — a duplicate
+        session (its decisions are serial by contract), sessions beyond
+        the slot capacity, rows past the ladder's largest bucket (the
+        slot path never chunks), or rows of the other carry style when
+        the batch mixes slot and host rows.  A no-op without the slot
+        cache — the host path dispatches every batch exactly as before.
+        """
+        engine = self.engine
+        cache = getattr(engine, "slot_cache", None)
+        if cache is None or not engine.recurrent or not batch:
+            return batch
+        largest = int(engine.buckets[-1])
+        style_slot = self._slot_row(batch[0])
+        keep: List[_Pending] = []
+        defer: List[_Pending] = []
+        seen: set = set()
+        for p in batch:
+            if self._slot_row(p) != style_slot or len(keep) >= largest:
+                defer.append(p)
+                continue
+            if style_slot and p.session is not None:
+                if p.session in seen or len(seen) >= cache.slots:
+                    defer.append(p)
+                    continue
+                seen.add(p.session)
+            keep.append(p)
+        if defer:
+            with self._cv:
+                self._pending.extendleft(reversed(defer))
+                self.deferred_count += len(defer)
+                self._cv.notify_all()
+        return keep
 
     def _dispatch(self, batch: List[_Pending], t_pickup: float) -> None:
         import jax
@@ -534,14 +624,26 @@ class MicroBatcher:
                     _resolve_exc(p.future, exc)
                 return
         obs = np.stack([p.obs for p in batch])
+        use_slots = (
+            getattr(engine, "slot_cache", None) is not None
+            and engine.recurrent
+            and all(self._slot_row(p) for p in batch)
+        )
         carries = (
             jax.tree.map(lambda *xs: np.stack(xs), *[p.carry for p in batch])
-            if engine.recurrent
+            if engine.recurrent and not use_slots
             else None
         )
         t_dispatch = time.perf_counter()
         try:
-            out = engine.decide_batch(obs, carries)
+            if use_slots:
+                out = engine.decide_batch_slots(
+                    obs,
+                    [p.session for p in batch],
+                    seed_carries=[p.carry for p in batch],
+                )
+            else:
+                out = engine.decide_batch(obs, carries)
         except BaseException as exc:
             # resolve every waiter with the fault and KEEP SERVING: one
             # poisoned dispatch must not stall the whole queue (the
@@ -580,6 +682,222 @@ class MicroBatcher:
             self.coalesced_total += n
             if len(self._records) + n <= self._records_cap:
                 self._records.extend(rows)
+        if self._instr is not None:
+            self._instr.on_batch_complete(rows)
+
+    # ------------------------------------------------------------------
+    # pipelined dispatch (pipeline=True): overlap host batch assembly
+    # with the device executable of the PREVIOUS batch.  The worker
+    # issues batch N+1 through engine.dispatch_async (which returns as
+    # soon as the executable is enqueued — JAX dispatch is async) and
+    # only then resolves batch N's outputs.  Depth is exactly one: at
+    # most one unresolved dispatch exists, which is what makes the
+    # engine's double-buffered staging (and CPU zero-copy aliasing)
+    # safe, and the worker only parks for pause() with nothing in
+    # flight — the deployer's flip/adopt contract is unchanged.
+    def _run_pipelined(self) -> None:
+        pending: Optional[_Inflight] = None
+        while True:
+            # a requested pause drains the pipeline first: the worker
+            # must reach the park point with nothing unresolved, and
+            # under sustained load the poll below would never block
+            if pending is not None and self._paused:
+                self._resolve_async(pending)
+                pending = None
+            # with a dispatch in flight, poll instead of block so the
+            # idle path resolves it promptly; _take(None) is the only
+            # park point, reached with nothing unresolved
+            first = self._take(None if pending is None else 0.0)
+            if first is None:
+                if pending is not None:
+                    self._resolve_async(pending)
+                    pending = None
+                    continue  # re-check: stop vs merely-empty queue
+                return  # stop requested; close() fails the rest
+            with self._cv:
+                self._inflight += 1
+            dispatched = False
+            try:
+                t_pickup = time.perf_counter()
+                batch = [first]
+                window_end = t_pickup + self.max_batch_wait_ms / 1000.0
+                while len(batch) < self.max_batch:
+                    remaining = window_end - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    nxt = self._take(remaining)
+                    if nxt is None:
+                        break
+                    batch.append(nxt)
+                now = time.perf_counter()
+                live: List[_Pending] = []
+                n_expired = 0
+                for p in batch:
+                    if p.deadline is not None and now > p.deadline:
+                        n_expired += 1
+                        _resolve_exc(
+                            p.future,
+                            DeadlineExceeded(
+                                "deadline passed inside the batching "
+                                "window (expired at dispatch)",
+                                phase="dispatch",
+                            ),
+                        )
+                    else:
+                        live.append(p)
+                if n_expired:
+                    with self._cv:
+                        self.deadline_miss_count += n_expired
+                    if self._instr is not None:
+                        self._instr.on_deadline_miss("dispatch", n_expired)
+                live = self._defer_conflicts(live)
+                if live:
+                    handle = self._dispatch_async(live, t_pickup)
+                    if handle is not None:
+                        dispatched = True
+                        # previous batch resolves AFTER the next one is
+                        # already running on device — the overlap
+                        if pending is not None:
+                            self._resolve_async(pending)
+                        pending = handle
+            finally:
+                if not dispatched:
+                    # the batch resolved synchronously (expired, fully
+                    # deferred, breaker-open, or dispatch fault) — this
+                    # iteration holds nothing in flight
+                    with self._cv:
+                        self._inflight -= 1
+                        self._cv.notify_all()
+
+    def _dispatch_async(
+        self, batch: List[_Pending], t_pickup: float
+    ) -> Optional[_Inflight]:
+        """Issue one micro-batch via ``engine.dispatch_async``; returns
+        the in-flight record, or None when the batch was fully resolved
+        here (breaker open / dispatch fault).  The caller's _inflight
+        slot transfers to the returned record — _resolve_async releases
+        it."""
+        import jax
+
+        engine = self.engine
+        n = len(batch)
+        if self.breaker is not None:
+            try:
+                self.breaker.allow()
+            except CircuitOpenError as exc:
+                with self._cv:
+                    self.breaker_open_count += n
+                if self._instr is not None:
+                    self._instr.on_breaker_open(n)
+                for p in batch:
+                    _resolve_exc(p.future, exc)
+                return None
+        obs = self._staged_obs(batch)
+        use_slots = (
+            getattr(engine, "slot_cache", None) is not None
+            and engine.recurrent
+            and all(self._slot_row(p) for p in batch)
+        )
+        t_dispatch = time.perf_counter()
+        try:
+            if use_slots:
+                handle = engine.dispatch_async(
+                    obs,
+                    sessions=[p.session for p in batch],
+                    seed_carries=[p.carry for p in batch],
+                )
+            else:
+                carries = (
+                    jax.tree.map(
+                        lambda *xs: np.stack(xs), *[p.carry for p in batch]
+                    )
+                    if engine.recurrent
+                    else None
+                )
+                handle = engine.dispatch_async(obs, carries)
+        except BaseException as exc:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            with self._cv:
+                self.dispatch_failures += 1
+            if self._instr is not None:
+                self._instr.on_dispatch_failure(n)
+            for p in batch:
+                _resolve_exc(p.future, exc)
+            return None
+        return _Inflight(handle, batch, engine, t_pickup, t_dispatch)
+
+    def _staged_obs(self, batch: List[_Pending]) -> np.ndarray:
+        """Assemble the batch's obs rows into a reusable double-buffered
+        staging array instead of a fresh np.stack per dispatch.  Two
+        buffers alternate per dispatch; with pipeline depth one a buffer
+        is never rewritten before the dispatch that read it resolved."""
+        engine = self.engine
+        shape = (self.max_batch, *engine.obs_shape)
+        bufs = getattr(self, "_obs_bufs", None)
+        if bufs is None or bufs[0].shape != shape:
+            bufs = [np.empty(shape, engine.obs_dtype) for _ in range(2)]
+            self._obs_bufs = bufs
+            self._obs_flip = 0
+        self._obs_flip ^= 1
+        buf = bufs[self._obs_flip]
+        for i, p in enumerate(batch):
+            buf[i] = p.obs
+        return buf[: len(batch)]
+
+    def _resolve_async(self, inf: _Inflight) -> None:
+        """Materialize one in-flight micro-batch: resolve the engine
+        handle (one device_get; slot mode also folds the carry mirror
+        update in), fan the rows out to their futures, and release the
+        _inflight slot."""
+        import jax
+
+        engine = inf.engine
+        batch = inf.batch
+        n = len(batch)
+        try:
+            out = inf.handle.resolve()
+        except BaseException as exc:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            with self._cv:
+                self.dispatch_failures += 1
+                self._inflight -= 1
+                self._cv.notify_all()
+            if self._instr is not None:
+                self._instr.on_dispatch_failure(n)
+            for p in batch:
+                _resolve_exc(p.future, exc)
+            return
+        if self.breaker is not None:
+            self.breaker.record_success()
+        t_done = time.perf_counter()
+        bucket = engine.bucket_for(n)
+        for i, p in enumerate(batch):
+            _resolve_result(
+                p.future,
+                type(out)(
+                    out.action[i],
+                    out.value[i],
+                    out.actor_out[i],
+                    jax.tree.map(lambda x: x[i], out.carry)
+                    if engine.recurrent
+                    else out.carry,
+                ),
+            )
+        rows = [
+            RequestRecord(
+                p.t_enqueue, inf.t_pickup, inf.t_dispatch, t_done, n, bucket
+            )
+            for p in batch
+        ]
+        with self._cv:
+            self.dispatches += 1
+            self.coalesced_total += n
+            if len(self._records) + n <= self._records_cap:
+                self._records.extend(rows)
+            self._inflight -= 1
+            self._cv.notify_all()
         if self._instr is not None:
             self._instr.on_batch_complete(rows)
 
@@ -626,4 +944,7 @@ def batcher_from_config(engine, config, *, instruments=None) -> MicroBatcher:
         default_deadline_ms=scfg.deadline_ms,
         breaker=breaker,
         instruments=instruments,
+        # pipelined assembly rides the slot knob: without device slots
+        # the worker loop is the original sync one, bitwise unchanged
+        pipeline=bool(scfg.session_slots > 0 and scfg.staging),
     )

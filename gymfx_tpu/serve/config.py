@@ -28,6 +28,10 @@ class ServeConfig(NamedTuple):
     feed_stale_after_s: Optional[float]  # live stale-feed watchdog; None = off
     # ---- continuous deployment (docs/serving.md, "Hot-swap") ----
     swap_parity_probe: int            # pinned-obs rows per shadow-parity probe; 0 = off
+    # ---- device-resident sessions (docs/serving.md) ----
+    session_slots: int                # device carry slots per engine; 0 = host-carry path
+    slot_mirror: bool                 # one-dispatch-late host mirror (failover handoff)
+    staging: bool                     # pipelined batch assembly (double-buffered dispatch)
 
 
 class FleetConfig(NamedTuple):
@@ -99,6 +103,11 @@ def serve_config_from(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve_swap_parity_probe must be >= 0 (0 disables), got {probe}"
         )
+    slots = int(config.get("serve_session_slots", 0) or 0)
+    if slots < 0:
+        raise ValueError(
+            f"serve_session_slots must be >= 0 (0 = host-carry path), got {slots}"
+        )
     return ServeConfig(
         buckets=_parse_buckets(config.get("serve_buckets")),
         max_batch_wait_ms=wait,
@@ -116,6 +125,9 @@ def serve_config_from(config: Dict[str, Any]) -> ServeConfig:
         breaker_recovery_s=recovery,
         feed_stale_after_s=_opt_positive(config, "feed_stale_after_s", float),
         swap_parity_probe=probe,
+        session_slots=slots,
+        slot_mirror=bool(config.get("serve_slot_mirror", True)),
+        staging=bool(config.get("serve_staging", True)),
     )
 
 

@@ -218,11 +218,25 @@ class BlueGreenDeployer:
                 "flip aborted"
             )
 
+    def _handoff_slots(self, engine: InferenceEngine) -> None:
+        """Move the outgoing engine's device slot cache (session table,
+        device carry state, host mirror) into ``engine`` so every
+        resident session keeps its carry bitwise across the flip.  A
+        no-op unless both engines run device slots.  Only called with
+        the batcher worker parked (or absent): no dispatch in flight on
+        either engine."""
+        src = getattr(self.active, "slot_cache", None)
+        dst = getattr(engine, "slot_cache", None)
+        if src is None or dst is None or src is dst:
+            return
+        dst.adopt(src)
+
     def _flip(self, engine: InferenceEngine) -> float:
         """Retarget the batcher at ``engine`` between micro-batches.
         Returns the pause->resume wall time (the swap latency)."""
         t0 = time.perf_counter()
         if self.batcher is None:
+            self._handoff_slots(engine)
             return time.perf_counter() - t0
         if not self.batcher.pause(self.pause_timeout_s):
             raise DeployError(
@@ -230,6 +244,7 @@ class BlueGreenDeployer:
                 f"{self.pause_timeout_s}s — routing unchanged"
             )
         try:
+            self._handoff_slots(engine)
             self.batcher.engine = self._wrap(engine)
         finally:
             self.batcher.resume()

@@ -65,6 +65,80 @@ class Decision(NamedTuple):
     carry: Any
 
 
+class EngineDispatch:
+    """An issued, not-yet-materialized engine dispatch.
+
+    ``dispatch_async`` returns one of these immediately after handing
+    the padded batch to the device: JAX dispatch is asynchronous, so the
+    caller (the pipelined micro-batcher) can assemble and dispatch the
+    NEXT batch while this one's executable is still running.
+    :meth:`resolve` blocks on the outputs (one ``device_get``), unpads
+    them, and — in slot mode with the mirror enabled — records the
+    fetched carry rows into the slot cache's host mirror on the same
+    fetch.  Idempotent: resolving twice returns the same Decision.
+    """
+
+    __slots__ = ("_engine", "_n", "_outputs", "_carry", "_sessions",
+                 "_mode", "_resolved")
+
+    def __init__(self, engine, n, outputs, carry, sessions, mode):
+        self._engine = engine
+        self._n = int(n)
+        self._outputs = outputs   # (action, value, actor_out) device arrays
+        self._carry = carry       # device carry rows (or None)
+        self._sessions = sessions  # per-row session ids (slot mode)
+        self._mode = mode         # "slots" | "host"
+        self._resolved = None
+
+    @property
+    def n(self) -> int:
+        return self._n
+
+    def resolve(self) -> "Decision":
+        if self._resolved is not None:
+            return self._resolved
+        import jax
+
+        engine = self._engine
+        n = self._n
+        if self._mode == "slots":
+            if self._carry is not None:
+                action, value, actor_out, carry2 = jax.device_get(
+                    (*self._outputs, self._carry)
+                )
+                cache = engine.slot_cache
+                if cache is not None:
+                    cache.update_mirror(self._sessions, carry2)
+                engine.mirror_fetch_bytes += sum(
+                    np.asarray(leaf).nbytes
+                    for leaf in jax.tree.leaves(carry2)
+                )
+            else:
+                action, value, actor_out = jax.device_get(self._outputs)
+            # carry stays device-resident: None here is the slot-mode
+            # contract (the mirror is the host view of session carry)
+            decision = Decision(
+                np.asarray(action)[:n],
+                np.asarray(value)[:n],
+                np.asarray(actor_out)[:n],
+                None,
+            )
+        else:
+            action, value, actor_out, carry2 = jax.device_get(
+                (*self._outputs, self._carry)
+            )
+            decision = Decision(
+                np.asarray(action)[:n],
+                np.asarray(value)[:n],
+                np.asarray(actor_out)[:n],
+                jax.tree.map(lambda x: np.asarray(x)[:n], carry2)
+                if engine.recurrent
+                else carry2,
+            )
+        self._resolved = decision
+        return decision
+
+
 def resolve_batch_mode(mode: str) -> str:
     """'auto' -> 'matmul' on TPU (MXU batching), 'exact' elsewhere
     (bit-identity guaranteed; CPU GEMM kernels reassociate)."""
@@ -181,8 +255,24 @@ class InferenceEngine:
                     params, obs_b, carry_b
                 )
 
+        self._batched = batched
+        self._donate = bool(donate)
         self._fwd = jax.jit(batched, donate_argnums=donate_argnums)
         self._compiled: Dict[int, Any] = {}
+        # ---- device-resident session slots (serve/slots.py) ----
+        # all None/empty until enable_slots(); the host-carry serving
+        # path above never consults them, so with serve_session_slots
+        # unset the engine behaves bitwise as before
+        self.slot_cache = None
+        self._fwd_slots = None
+        self._compiled_slots: Dict[int, Any] = {}
+        self._seed_fn = None
+        self._obs_staging: Dict[int, list] = {}
+        self._staging_flip = 0
+        self.slot_dispatches = 0
+        self.slot_decisions = 0
+        self.mirror_fetch_bytes = 0   # carry bytes fetched for the mirror
+        self.seed_upload_bytes = 0    # carry bytes uploaded to seed slots
         # serialized against concurrent decide_batch callers: the
         # executables are stateless but the late-compile bookkeeping and
         # jax dispatch are cheapest kept single-file (the MicroBatcher
@@ -433,6 +523,245 @@ class InferenceEngine:
             else out.carry,
         )
 
+    # ------------------------------------------------------------------
+    # device-resident session slots (serve/slots.py, docs/serving.md
+    # "Device-resident sessions") — a parallel AOT ladder whose fused
+    # gather→policy→scatter program keeps recurrent carry on device.
+    # The host-carry path above is untouched: with serve_session_slots
+    # unset none of this is compiled or consulted.
+    def enable_slots(self, n_slots: int, *, mirror: bool = True):
+        """Pre-allocate the device slot arrays and AOT-compile the fused
+        slot ladder (one executable per bucket, like :meth:`warmup`).
+        Idempotent for the same capacity; a no-op (returns None) on
+        stateless policies, which have no carry to cache.  Returns the
+        :class:`~gymfx_tpu.serve.slots.SlotCache`."""
+        import jax
+
+        if not self.recurrent:
+            return None
+        if self.slot_cache is not None:
+            if self.slot_cache.slots != int(n_slots):
+                raise ValueError(
+                    f"slot cache already enabled with "
+                    f"{self.slot_cache.slots} slots (asked for {n_slots})"
+                )
+            return self.slot_cache
+        from gymfx_tpu.serve.slots import SlotCache
+
+        cache = SlotCache(int(n_slots), self._carry0, mirror=mirror)
+        batched = self._batched
+
+        def fused(params, state, obs_b, gather_idx, scatter_idx):
+            carry_b = jax.tree.map(lambda s: s[gather_idx], state)
+            action, value, actor_out, carry2 = batched(
+                params, obs_b, carry_b
+            )
+            new_state = jax.tree.map(
+                lambda s, c: s.at[scatter_idx].set(c), state, carry2
+            )
+            return action, value, actor_out, carry2, new_state
+
+        def seed(state, slot, carry_row):
+            return jax.tree.map(
+                lambda s, c: s.at[slot].set(c.astype(s.dtype)),
+                state,
+                carry_row,
+            )
+
+        # donate the slot state (rebuilt by every dispatch: scatter is
+        # then in place) and the padded obs; TPU only, like the host
+        # ladder — CPU ignores donation with a warning
+        self._fwd_slots = jax.jit(
+            fused, donate_argnums=(1, 2) if self._donate else ()
+        )
+        self._seed_fn = jax.jit(
+            seed, donate_argnums=(0,) if self._donate else ()
+        )
+        self.slot_cache = cache
+        self.warmup_slots()
+        # one throwaway seed into SCRATCH compiles the seeder at boot
+        cache.state = self._seed_fn(
+            cache.state, np.int32(cache.scratch_row), self.initial_carry()
+        )
+        return cache
+
+    def warmup_slots(self) -> None:
+        """AOT-compile the fused slot ladder for every bucket and run
+        each once (gathering INITIAL, scattering SCRATCH — session rows
+        are untouched).  Idempotent."""
+        if self.slot_cache is None:
+            return
+        cache = self.slot_cache
+        for bucket in self.buckets:
+            if bucket in self._compiled_slots:
+                continue
+            obs = np.broadcast_to(
+                self.neutral_obs, (bucket, *self.obs_shape)
+            ).copy()
+            gather = np.full(bucket, cache.initial_row, np.int32)
+            scatter = np.full(bucket, cache.scratch_row, np.int32)
+            t0 = time.perf_counter()
+            exe = self._fwd_slots.lower(
+                self.params, cache.state, obs, gather, scatter
+            ).compile()
+            compile_s = time.perf_counter() - t0
+            out = exe(self.params, cache.state, obs, gather, scatter)
+            cache.state = out[4]
+            self._compiled_slots[bucket] = exe
+            if self.on_compile is not None:
+                self.on_compile(bucket, compile_s, False)
+
+    def _dispatch_slots(
+        self,
+        obs_pad: np.ndarray,
+        gather_idx: np.ndarray,
+        scatter_idx: np.ndarray,
+        bucket: int,
+    ):
+        exe = self._compiled_slots.get(bucket)
+        cache = self.slot_cache
+        if exe is None:
+            t0 = time.perf_counter()
+            exe = self._fwd_slots.lower(
+                self.params, cache.state, obs_pad, gather_idx, scatter_idx
+            ).compile()
+            self._compiled_slots[bucket] = exe
+            self.late_compiles += 1
+            if self.on_compile is not None:
+                self.on_compile(bucket, time.perf_counter() - t0, True)
+        return exe(self.params, cache.state, obs_pad, gather_idx, scatter_idx)
+
+    def _staged_pad(self, obs: np.ndarray, n: int, bucket: int) -> np.ndarray:
+        """Pad ``obs`` into a double-buffered host staging buffer
+        (alternating per dispatch).  Safe with pipeline depth one: a
+        buffer is rewritten two dispatches later, after the dispatch
+        that referenced it has been resolved — so even a backend that
+        aliases host numpy inputs never sees a concurrent rewrite.
+        Callers must hold the dispatch lock."""
+        bufs = self._obs_staging.get(bucket)
+        if bufs is None:
+            bufs = [
+                np.empty((bucket, *self.obs_shape), self.obs_dtype)
+                for _ in range(2)
+            ]
+            for b in bufs:
+                b[:] = self.neutral_obs
+            self._obs_staging[bucket] = bufs
+        self._staging_flip ^= 1
+        buf = bufs[self._staging_flip]
+        buf[:n] = obs
+        buf[n:] = self.neutral_obs
+        return buf
+
+    def dispatch_async(
+        self,
+        obs_batch: Any,
+        carries: Any = None,
+        *,
+        sessions: Optional[Sequence[Optional[str]]] = None,
+        seed_carries: Optional[Sequence[Any]] = None,
+    ) -> EngineDispatch:
+        """Issue one dispatch WITHOUT materializing the outputs; returns
+        an :class:`EngineDispatch` whose ``resolve()`` blocks on them.
+
+        With the slot cache enabled and per-row ``sessions`` given, the
+        fused slot ladder runs: carry is gathered from and scattered to
+        the device slots (zero per-decision carry transfer; a new
+        session's slot is seeded from ``seed_carries[i]`` when provided
+        — the failover re-pin — else from the initial carry).  Rows with
+        ``sessions[i] is None`` compute from the initial carry and leave
+        no state behind.  Otherwise the host-carry semantics of
+        :meth:`decide_batch` apply (``carries`` defaults to the initial
+        batch for recurrent policies).  The batch must fit the ladder:
+        the async path never chunks.
+        """
+        import jax
+
+        obs = np.asarray(obs_batch, self.obs_dtype)
+        if obs.ndim == len(self.obs_shape):
+            obs = obs[None]
+        if obs.shape[1:] != self.obs_shape:
+            raise ValueError(
+                f"obs batch shape {obs.shape} does not match "
+                f"(n, {', '.join(map(str, self.obs_shape))})"
+            )
+        n = int(obs.shape[0])
+        bucket = self.bucket_for(n)
+        if n > bucket:
+            raise ValueError(
+                f"async dispatch of {n} rows exceeds the largest bucket "
+                f"{bucket} (the async path never chunks)"
+            )
+        cache = self.slot_cache
+        if cache is not None and self.recurrent and sessions is not None:
+            sessions = [None if s is None else str(s) for s in sessions]
+            if len(sessions) != n:
+                raise ValueError(
+                    f"{len(sessions)} sessions for {n} obs rows"
+                )
+            with self._lock:
+                gather, scatter, seeds = cache.assign(
+                    bucket, sessions, seed_carries
+                )
+                for slot, carry in seeds:
+                    row = jax.tree.map(np.asarray, carry)
+                    cache.state = self._seed_fn(
+                        cache.state, np.int32(slot), row
+                    )
+                    self.seed_upload_bytes += sum(
+                        leaf.nbytes for leaf in jax.tree.leaves(row)
+                    )
+                obs_pad = self._staged_pad(obs, n, bucket)
+                out = self._dispatch_slots(obs_pad, gather, scatter, bucket)
+                cache.state = out[4]
+                self.slot_dispatches += 1
+                self.slot_decisions += n
+            carry_out = out[3] if cache.mirror_enabled else None
+            return EngineDispatch(
+                self, n, out[:3], carry_out, sessions, "slots"
+            )
+        # host-carry async path (stateless engines, or explicit carries)
+        if self.recurrent:
+            if carries is None:
+                carries = self.initial_carry_batch(n)
+            carry = jax.tree.map(lambda x: np.asarray(x), carries)
+            pad_carry = self.initial_carry_batch(bucket)
+            carry_pad = jax.tree.map(
+                lambda full, got: _fill_rows(full, got, n), pad_carry, carry
+            )
+        else:
+            carry_pad = self._carry0
+        with self._lock:
+            obs_pad = self._staged_pad(obs, n, bucket)
+            out = self._dispatch(obs_pad, carry_pad, bucket)
+        return EngineDispatch(self, n, out[:3], out[3], None, "host")
+
+    def decide_batch_slots(
+        self,
+        obs_batch: Any,
+        sessions: Sequence[Optional[str]],
+        seed_carries: Optional[Sequence[Any]] = None,
+    ) -> Decision:
+        """Synchronous slot-mode decide: one fused dispatch, resolved
+        immediately.  Decision.carry is None — carry stays on device
+        (the mirror holds the host view)."""
+        return self.dispatch_async(
+            obs_batch, sessions=sessions, seed_carries=seed_carries
+        ).resolve()
+
+    def slot_stats(self) -> Dict[str, Any]:
+        """Slot-cache counters for telemetry and the bench contract."""
+        out = {
+            "enabled": self.slot_cache is not None,
+            "slot_dispatches": self.slot_dispatches,
+            "slot_decisions": self.slot_decisions,
+            "mirror_fetch_bytes": self.mirror_fetch_bytes,
+            "seed_upload_bytes": self.seed_upload_bytes,
+        }
+        if self.slot_cache is not None:
+            out.update(self.slot_cache.stats())
+        return out
+
 
 def _leaf_signature(leaf: Any) -> Tuple[Tuple[int, ...], str]:
     """(shape, dtype-name) of a params leaf without forcing a host copy
@@ -556,6 +885,12 @@ def engine_from_config(
         ),
         warmup=bool(warmup and scfg.warmup),
     )
+    if scfg.session_slots > 0 and warmup and scfg.warmup:
+        # device-resident session carry (serve/slots.py) — a no-op for
+        # stateless policies; skipped on warmup=False boots (the slot
+        # ladder, like the host ladder, must never compile lazily in
+        # serving, so a cold boot stays cold)
+        engine.enable_slots(scfg.session_slots, mirror=scfg.slot_mirror)
     return EngineBundle(
         engine=engine,
         env=env,

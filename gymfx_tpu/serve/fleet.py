@@ -89,13 +89,45 @@ def params_digest(params: Any) -> str:
     return h.hexdigest()
 
 
-def _copy_carry(carry: Any) -> Any:
-    """Host-side copy of a carry tree: the store must own its arrays,
-    not views into a fetched device batch that the next dispatch may
-    reuse."""
+def copy_carry_owned(carry: Any, *, adopt: bool = False) -> tuple:
+    """Host-side ownership of a carry tree for the session store.
+
+    The store must own its arrays — not views into a fetched batch the
+    resolver slices per row, not device arrays, and never a buffer the
+    caller can still mutate.  Array flags cannot prove the caller holds
+    no reference (a fresh ``np.zeros`` is ``owndata`` yet still the
+    caller's), so adoption is strictly opt-in: with ``adopt=True`` the
+    call site vouches the tree was materialized for this call and is
+    not retained elsewhere, and leaves that are already owned, writable
+    host numpy arrays (``base is None`` + ``owndata``) are taken as-is
+    instead of deep-copied; everything else — and everything when
+    ``adopt`` is False — is copied.  Returns ``(tree, copied,
+    avoided)`` with per-leaf counts so the store can account for the
+    copies it skipped.
+    """
     import jax
 
-    return jax.tree.map(lambda x: np.array(x), carry)
+    counts = [0, 0]  # copied, avoided
+
+    def leaf(x: Any) -> Any:
+        if (
+            adopt
+            and isinstance(x, np.ndarray)
+            and x.base is None
+            and x.flags.owndata
+            and x.flags.writeable
+        ):
+            counts[1] += 1
+            return x
+        counts[0] += 1
+        return np.array(x)
+
+    return jax.tree.map(leaf, carry), counts[0], counts[1]
+
+
+def _copy_carry(carry: Any) -> Any:
+    """Back-compat wrapper over :func:`copy_carry_owned` (tree only)."""
+    return copy_carry_owned(carry)[0]
 
 
 def _fulfil(fut: Future, value: Any) -> bool:
@@ -126,6 +158,8 @@ class SessionStateStore:
         self._lock = threading.Lock()
         self._sessions: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
         self.evictions = 0
+        self.carry_copies = 0          # leaves deep-copied on record
+        self.carry_copies_avoided = 0  # already-owned leaves adopted as-is
 
     def _entry(self, session: str) -> Dict[str, Any]:
         entry = self._sessions.get(session)
@@ -152,11 +186,32 @@ class SessionStateStore:
             entry = self._sessions.get(session)
             return None if entry is None else entry["replica"]
 
-    def record_decision(self, session: str, carry: Any) -> None:
-        """Store the post-decision carry (copied host-side)."""
-        copied = _copy_carry(carry)
+    def record_decision(
+        self, session: str, carry: Any, *, owned: bool = False
+    ) -> None:
+        """Store the post-decision carry.  By default every leaf is
+        deep-copied so the store never aliases caller memory; a call
+        site that materialized the tree for this call alone passes
+        ``owned=True`` and already-owned numpy leaves are adopted
+        without the redundant copy (both outcomes counted)."""
+        owned_tree, copied, avoided = copy_carry_owned(carry, adopt=owned)
         with self._lock:
-            self._entry(session)["carry"] = copied
+            self.carry_copies += copied
+            self.carry_copies_avoided += avoided
+            self._entry(session)["carry"] = owned_tree
+
+    def clear_carry(self, session: str) -> None:
+        """Drop a session's stored carry, keeping its replica pin.
+
+        The slot-mode handshake: once a device-slot decision resolves,
+        the slot is authoritative and the host copy (a failover seed
+        recorded from the mirror) is CONSUMED — so a later slot eviction
+        restarts the session from the initial carry instead of
+        resurrecting this stale host state."""
+        with self._lock:
+            entry = self._sessions.get(session)
+            if entry is not None:
+                entry["carry"] = None
 
     def pin(self, session: str, replica_id: int) -> None:
         with self._lock:
@@ -275,7 +330,11 @@ class DecisionFleet:
             raise ValueError("DecisionFleet needs at least one engine")
         self.name = str(name)
         self._factory = batcher_factory
-        self.store = session_store or SessionStateStore()
+        # NOT `session_store or ...`: an empty store is falsy (__len__)
+        # and a caller-supplied store must never be silently replaced
+        self.store = (
+            SessionStateStore() if session_store is None else session_store
+        )
         self.max_queue = None if max_queue is None else int(max_queue)
         self.retry_limit = int(retry_limit)
         self.checkpoint_dir = None if checkpoint_dir is None else str(checkpoint_dir)
@@ -612,7 +671,10 @@ class DecisionFleet:
             self._outstanding.setdefault(replica.id, set()).add(req)
         try:
             inner = replica.batcher.submit(
-                req.obs, carry, deadline_ms=req.deadline_ms
+                req.obs,
+                carry,
+                deadline_ms=req.deadline_ms,
+                session=req.session,
             )
         except (ShedError, DeadlineExceeded) as exc:
             # per-replica admission decisions are typed resolutions,
@@ -670,7 +732,17 @@ class DecisionFleet:
                     and req.carry is None
                     and self.affine
                 ):
-                    self.store.record_decision(req.session, decision.carry)
+                    if decision.carry is not None:
+                        self.store.record_decision(
+                            req.session, decision.carry
+                        )
+                    else:
+                        # device-slot decision: carry never left the
+                        # device.  Consume any host seed so a later slot
+                        # eviction restarts from initial, never from
+                        # this now-stale copy (the replica's mirror is
+                        # the live host view for failover)
+                        self.store.clear_carry(req.session)
             return
         if isinstance(exc, (ShedError, DeadlineExceeded)):
             # typed overload semantics propagate unchanged — retrying a
@@ -782,6 +854,14 @@ class DecisionFleet:
             pass
         replica.batcher.close(self.close_timeout_s)
 
+        # device-slot lanes: hand the dead lane's host mirror of session
+        # carry to the store BEFORE anything re-routes, so a surviving
+        # replica seeds its slots from it (at most one unresolved
+        # dispatch stale — and that dispatch's requests are exactly the
+        # ones re-routed below, which re-decide from the mirror carry
+        # and reproduce the unfailed stream bitwise in exact mode)
+        mirror_flushed = self._flush_slot_mirror(replica)
+
         promoted: Optional[Replica] = None
         verified = False
         if standby_engine is not None:
@@ -831,7 +911,28 @@ class DecisionFleet:
             "verified": bool(verified),
             "moved_sessions": len(moved_sessions),
             "redistributed": len(stranded),
+            "mirror_flushed": mirror_flushed,
         }
+
+    def _flush_slot_mirror(self, replica: Replica) -> int:
+        """Record a (dead) replica's slot-cache mirror into the session
+        store; returns sessions flushed (0 without a slot cache).  Never
+        raises — failover must complete even if the lane is wrecked."""
+        try:
+            cache = getattr(replica.engine, "slot_cache", None)
+            if cache is None:
+                return 0
+            flushed = 0
+            for session, carry in cache.mirror_snapshot():
+                if carry is not None:
+                    # the mirror tree is private to the dead replica's
+                    # cache and its entries are replaced, never mutated
+                    # in place — owned leaves are safe to adopt
+                    self.store.record_decision(session, carry, owned=True)
+                    flushed += 1
+            return flushed
+        except Exception:
+            return 0
 
     def _verify_standby(self, engine: Any) -> bool:
         """A standby is promotable when it carries the fleet's current

@@ -191,6 +191,39 @@ class ServeInstruments:
                 ),
                 **self._base,
             )
+        if engine is not None and getattr(engine, "slot_cache", None) is not None:
+            # device-resident sessions (serve/slots.py): scrape-time
+            # callbacks through batcher.engine so the gauges follow the
+            # active engine across blue/green flips, like late_compiles
+            slot_specs = (
+                ("gymfx_serve_slot_resident",
+                 "Sessions resident in the device slot cache",
+                 lambda e: float(len(e.slot_cache))),
+                ("gymfx_serve_slot_evictions_total",
+                 "LRU slot evictions (evicted sessions restart from the "
+                 "initial carry; monotonic, read at scrape time)",
+                 lambda e: float(e.slot_cache.evictions)),
+                ("gymfx_serve_slot_decisions_total",
+                 "Decisions served through the fused slot ladder "
+                 "(monotonic, read at scrape time)",
+                 lambda e: float(getattr(e, "slot_decisions", 0))),
+                ("gymfx_serve_slot_mirror_bytes_total",
+                 "Carry bytes fetched for the one-dispatch-late host "
+                 "mirror (monotonic, read at scrape time)",
+                 lambda e: float(getattr(e, "mirror_fetch_bytes", 0))),
+            )
+            for gname, help_text, reader in slot_specs:
+                gauge = self.registry.gauge(
+                    gname, help_text, labels=("batcher",) + extra
+                )
+                gauge.set_function(
+                    lambda b=batcher, r=reader: (
+                        r(b.engine)
+                        if getattr(b.engine, "slot_cache", None) is not None
+                        else 0.0
+                    ),
+                    **self._base,
+                )
         if batcher.breaker is not None:
             from gymfx_tpu.telemetry.registry import register_resilience
 

@@ -1,0 +1,594 @@
+"""Device-resident session slots (gymfx_tpu/serve/slots.py, the
+``serve_session_slots`` knob — docs/serving.md, "Device-resident
+sessions").
+
+The slot contract: decisions served through the fused
+gather->policy->scatter ladder are BITWISE identical to the host-carry
+path in exact batch mode — per policy family, per bucket, mid-stream,
+across LRU evictions, across ``fail_over()`` and across a blue/green
+promote+rollback; an evicted session restarts from the INITIAL carry,
+never a stale one; with the knob unset nothing here is constructed and
+the serve path is byte-for-byte the host-carry one.
+"""
+import tempfile
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gymfx_tpu.resilience.faults import FlakyEngine
+from gymfx_tpu.serve.batcher import MicroBatcher
+from gymfx_tpu.serve.deploy import BlueGreenDeployer
+from gymfx_tpu.serve.engine import InferenceEngine
+from gymfx_tpu.serve.fleet import (
+    DecisionFleet,
+    SessionStateStore,
+    copy_carry_owned,
+)
+from gymfx_tpu.serve.slots import SlotCache
+from gymfx_tpu.train.policies import make_trainer_policy
+
+OBS_DIM = 12
+WINDOW = 6
+TOKEN_DIM = 3
+BUCKETS = (1, 4, 8)
+
+_KWARGS = {
+    "mlp": {"hidden": [16, 16]},
+    "lstm": {"hidden": 16},
+    "transformer": {"d_model": 16, "n_heads": 2},
+}
+
+
+def _build(name, *, buckets=BUCKETS, seed=0):
+    pol = make_trainer_policy(
+        name,
+        continuous=False,
+        dtype=jnp.float32,
+        kwargs=dict(_KWARGS[name]),
+        window=WINDOW,
+    )
+    rng = np.random.default_rng(sum(map(ord, name)) + seed)
+    shape = (WINDOW, TOKEN_DIM) if name == "transformer" else (OBS_DIM,)
+    example = rng.standard_normal(shape).astype(np.float32)
+    carry0 = pol.initial_carry(())
+    key = jax.random.PRNGKey(seed)
+    if jax.tree.leaves(carry0):
+        params = pol.init(key, jnp.asarray(example), carry0)
+    else:
+        params = pol.init(key, jnp.asarray(example))
+    eng = InferenceEngine(
+        pol, params, example, buckets=buckets, batch_mode="exact"
+    )
+    return pol, params, eng, rng
+
+
+def _rows(rng, eng, n):
+    return rng.standard_normal((n, *eng.obs_shape)).astype(np.float32)
+
+
+def _assert_bitwise(a, b, msg):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype, (msg, a.dtype, b.dtype)
+    assert np.array_equal(a, b), (msg, a, b)
+
+
+def _assert_decision_rows_equal(slot_d, host_d, n, msg):
+    for i in range(n):
+        _assert_bitwise(slot_d.action[i], host_d.action[i], f"{msg} action")
+        _assert_bitwise(slot_d.value[i], host_d.value[i], f"{msg} value")
+        _assert_bitwise(
+            slot_d.actor_out[i], host_d.actor_out[i], f"{msg} actor"
+        )
+
+
+# ---------------------------------------------------------------------------
+# engine-level bitwise parity
+
+
+@pytest.mark.parametrize("name", ["mlp", "lstm", "transformer"])
+def test_slot_parity_every_bucket_mid_stream(name):
+    """Slot-served decision streams match host-carry threading bitwise
+    at every bucket width, several steps deep (mid-stream carries, not
+    just the zero carry)."""
+    _pol, _params, eng, rng = _build(name)
+    handle = eng.enable_slots(8)
+    if not eng.recurrent:
+        # stateless policies have nothing to cache: the knob no-ops
+        assert handle is None and eng.slot_cache is None
+        return
+    assert eng.slot_cache is not None
+    compiles_after_boot = eng.late_compiles
+    for n in (1, 3, 4, 8):
+        sessions = [f"w{n}-{i}" for i in range(n)]
+        host_carry = eng.initial_carry_batch(n)
+        for step in range(3):
+            obs = _rows(rng, eng, n)
+            host_d = eng.decide_batch(obs, host_carry)
+            host_carry = host_d.carry
+            slot_d = eng.decide_batch_slots(obs, sessions)
+            assert slot_d.carry is None  # slot-mode contract
+            _assert_decision_rows_equal(
+                slot_d, host_d, n, f"{name} n={n} step={step}"
+            )
+    # the warm slot ladder never compiles on the decision path
+    assert eng.late_compiles == compiles_after_boot
+
+
+def test_seed_carries_resume_a_host_session_bitwise():
+    """A session arriving WITH a host carry (fleet handoff, failover
+    re-pin) seeds its slot from that carry and continues bitwise."""
+    _pol, params, eng, rng = _build("lstm")
+    eng.enable_slots(4)
+    n = 3
+    # advance reference sessions two steps on the host path
+    host_carry = eng.initial_carry_batch(n)
+    for _ in range(2):
+        obs = _rows(rng, eng, n)
+        host_carry = eng.decide_batch(obs, host_carry).carry
+    seeds = [jax.tree.map(lambda x, i=i: x[i], host_carry) for i in range(n)]
+    seeded_before = eng.slot_cache.seeded
+    obs = _rows(rng, eng, n)
+    host_d = eng.decide_batch(obs, host_carry)
+    slot_d = eng.decide_batch_slots(
+        obs, ["h0", "h1", "h2"], seed_carries=seeds
+    )
+    assert eng.slot_cache.seeded == seeded_before + n
+    assert eng.seed_upload_bytes > 0
+    _assert_decision_rows_equal(slot_d, host_d, n, "seeded resume")
+
+
+def test_mirror_tracks_host_carry_exactly():
+    """The one-dispatch-late host mirror holds the session's post-step
+    carry bitwise (each decide_batch_slots call resolves, so here the
+    mirror is current at every step)."""
+    _pol, _params, eng, rng = _build("lstm")
+    eng.enable_slots(4)
+    host_carry = eng.initial_carry_batch(2)
+    for step in range(3):
+        obs = _rows(rng, eng, 2)
+        host_carry = eng.decide_batch(obs, host_carry).carry
+        eng.decide_batch_slots(obs, ["m0", "m1"])
+        for i, s in enumerate(["m0", "m1"]):
+            mirror = eng.slot_cache.mirror_carry(s)
+            assert mirror is not None
+            for a, b in zip(
+                jax.tree.leaves(mirror),
+                jax.tree.leaves(
+                    jax.tree.map(lambda x, i=i: x[i], host_carry)
+                ),
+            ):
+                _assert_bitwise(a, b, f"mirror {s} step {step}")
+    assert eng.mirror_fetch_bytes > 0
+
+
+# ---------------------------------------------------------------------------
+# slot exhaustion / LRU eviction
+
+
+def test_evicted_session_restarts_from_initial_never_stale():
+    _pol, _params, eng, rng = _build("lstm", buckets=(1, 2))
+    eng.enable_slots(2)
+    cache = eng.slot_cache
+    obs_a = _rows(rng, eng, 1)
+    # advance "a" two steps so its slot carry is far from initial
+    eng.decide_batch_slots(obs_a, ["a"])
+    eng.decide_batch_slots(_rows(rng, eng, 1), ["a"])
+    # two new sessions evict LRU "a", then LRU "b"
+    eng.decide_batch_slots(_rows(rng, eng, 1), ["b"])
+    assert cache.evictions == 0
+    eng.decide_batch_slots(_rows(rng, eng, 1), ["c"])
+    assert cache.evictions == 1 and "a" not in cache.sessions()
+    eng.decide_batch_slots(_rows(rng, eng, 1), ["d"])
+    assert cache.evictions == 2 and "b" not in cache.sessions()
+    # "a" comes back: it must restart from the INITIAL carry — compare
+    # against a fresh host decision, not the stream it had before
+    fresh = _rows(rng, eng, 1)
+    host_d = eng.decide_batch(fresh, eng.initial_carry_batch(1))
+    slot_d = eng.decide_batch_slots(fresh, ["a"])
+    assert cache.evictions == 3
+    _assert_decision_rows_equal(slot_d, host_d, 1, "evicted restart")
+
+
+def test_batch_wider_than_capacity_raises_at_engine():
+    _pol, _params, eng, _rng = _build("lstm")
+    eng.enable_slots(2)
+    obs = np.zeros((4, OBS_DIM), np.float32)
+    with pytest.raises(ValueError):
+        eng.decide_batch_slots(obs, ["a", "b", "c", "d"])
+
+
+def test_duplicate_sessions_in_one_batch_raise_at_engine():
+    _pol, _params, eng, _rng = _build("lstm")
+    eng.enable_slots(4)
+    obs = np.zeros((2, OBS_DIM), np.float32)
+    with pytest.raises(ValueError):
+        eng.decide_batch_slots(obs, ["a", "a"])
+
+
+def test_concurrent_eviction_hammer_all_resolve():
+    """12 sessions over 4 slots, 6 threads submitting through the
+    pipelined batcher: every request resolves, evictions happen, and
+    the engine stays internally consistent (a fresh session afterwards
+    still matches the host path bitwise)."""
+    _pol, _params, eng, rng = _build("lstm")
+    eng.enable_slots(4)
+    batcher = MicroBatcher(eng, max_batch_wait_ms=0.5, pipeline=True)
+    sessions = [f"h{i}" for i in range(12)]
+    pool = _rows(rng, eng, 32)
+    errors = []
+
+    def client(cid):
+        r = np.random.default_rng(cid)
+        for j in range(20):
+            s = sessions[int(r.integers(len(sessions)))]
+            try:
+                d = batcher.submit(
+                    pool[int(r.integers(len(pool)))], session=s
+                ).result(timeout=30)
+                assert d.carry is None
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert eng.slot_cache.evictions > 0
+    assert len(eng.slot_cache) <= 4
+    batcher.close()
+    fresh = _rows(rng, eng, 1)
+    host_d = eng.decide_batch(fresh, eng.initial_carry_batch(1))
+    slot_d = eng.decide_batch_slots(fresh, ["post-hammer"])
+    _assert_decision_rows_equal(slot_d, host_d, 1, "post-hammer")
+
+
+# ---------------------------------------------------------------------------
+# knob unset: the serve path is the host-carry path, untouched
+
+
+def test_knob_unset_leaves_serve_path_bitwise_identical():
+    _pol, _params, plain, rng = _build("lstm")
+    _pol2, _params2, slotted, _rng2 = _build("lstm")
+    slotted.enable_slots(8)
+    assert plain.slot_cache is None
+    # enabling slots must not perturb the HOST path either: same rows,
+    # same carries, bitwise-equal host decisions from both engines
+    carries = plain.initial_carry_batch(3)
+    for step in range(2):
+        obs = _rows(rng, plain, 3)
+        a = plain.decide_batch(obs, carries)
+        b = slotted.decide_batch(obs, carries)
+        _assert_decision_rows_equal(a, b, 3, f"host path step {step}")
+        carries = a.carry
+    # knob-off batcher is the original sync worker
+    b0 = MicroBatcher(plain, max_batch_wait_ms=0.5)
+    assert b0.pipeline is False and b0.health()["pipeline"] is False
+    b0.close()
+
+
+def test_serve_config_parses_slot_knobs():
+    from gymfx_tpu.serve.config import serve_config_from
+
+    scfg = serve_config_from({})
+    assert scfg.session_slots == 0
+    assert scfg.slot_mirror is True and scfg.staging is True
+    scfg = serve_config_from(
+        {"serve_session_slots": 16, "serve_slot_mirror": False,
+         "serve_staging": False}
+    )
+    assert scfg.session_slots == 16
+    assert scfg.slot_mirror is False and scfg.staging is False
+    with pytest.raises(ValueError):
+        serve_config_from({"serve_session_slots": -1})
+
+
+# ---------------------------------------------------------------------------
+# batcher integration
+
+
+def test_pipelined_batcher_defers_duplicate_sessions():
+    _pol, _params, eng, rng = _build("lstm")
+    eng.enable_slots(4)
+    batcher = MicroBatcher(eng, max_batch_wait_ms=20.0, pipeline=True)
+    batcher.pause()
+    row = _rows(rng, eng, 1)[0]
+    f1 = batcher.submit(row, session="dup")
+    f2 = batcher.submit(row, session="dup")
+    batcher.resume()
+    d1, d2 = f1.result(timeout=30), f2.result(timeout=30)
+    assert d1.action.shape == () and d2.action.shape == ()
+    assert batcher.deferred_count >= 1
+    # serial semantics: the second decision saw the first one's carry
+    host = eng.initial_carry_batch(1)
+    h1 = eng.decide_batch(row[None], host)
+    h2 = eng.decide_batch(row[None], h1.carry)
+    _assert_bitwise(d1.actor_out, h1.actor_out[0], "dup first")
+    _assert_bitwise(d2.actor_out, h2.actor_out[0], "dup second")
+    batcher.close()
+
+
+def test_pause_drains_the_pipeline_under_load():
+    """pause() must park the pipelined worker even while submits keep
+    arriving — the depth-1 pipeline drains instead of wedging."""
+    _pol, _params, eng, rng = _build("lstm")
+    eng.enable_slots(8)
+    batcher = MicroBatcher(eng, max_batch_wait_ms=0.5, pipeline=True)
+    stop = threading.Event()
+    pool = _rows(rng, eng, 8)
+
+    def pump():
+        i = 0
+        while not stop.is_set():
+            try:
+                batcher.submit(pool[i % 8], session=f"p{i % 6}")
+            except Exception:
+                return
+            i += 1
+            time.sleep(0.001)
+
+    t = threading.Thread(target=pump)
+    t.start()
+    try:
+        time.sleep(0.05)
+        done = threading.Event()
+
+        def do_pause():
+            batcher.pause()
+            done.set()
+
+        pt = threading.Thread(target=do_pause)
+        pt.start()
+        assert done.wait(timeout=10.0), "pause() wedged under load"
+        assert batcher._inflight == 0
+        batcher.resume()
+        pt.join()
+    finally:
+        stop.set()
+        t.join()
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# FlakyEngine composition (satellite: fault injection over slots)
+
+
+def test_flaky_engine_composes_with_slot_dispatch():
+    _pol, _params, eng, rng = _build("lstm", buckets=(1, 2))
+    eng.enable_slots(2)
+    flaky = FlakyEngine(eng, plan=())
+    obs = _rows(rng, eng, 1)
+    host_d = eng.decide_batch(obs, eng.initial_carry_batch(1))
+    slot_d = flaky.decide_batch_slots(obs, ["f0"])
+    _assert_decision_rows_equal(slot_d, host_d, 1, "flaky delegation")
+    assert flaky.dispatch_calls >= 1
+    flaky.push_faults("exc")
+    with pytest.raises(RuntimeError):
+        flaky.decide_batch_slots(_rows(rng, eng, 1), ["f0"])
+    assert flaky.faults_injected == 1
+    # the fault burned at dispatch; the NEXT slot decision is clean and
+    # the slot state was not corrupted by the faulted dispatch
+    d = flaky.decide_batch_slots(_rows(rng, eng, 1), ["f0"])
+    assert d.action.shape == (1,)
+
+
+# ---------------------------------------------------------------------------
+# fleet failover + blue/green with device-resident sessions
+
+
+def _slot_fleet(params_engines, standby, store):
+    def factory(engine, replica_id):
+        return MicroBatcher(engine, max_batch_wait_ms=0.5, pipeline=True)
+
+    return DecisionFleet(
+        params_engines,
+        factory,
+        standby_engines=[standby],
+        session_store=store,
+    )
+
+
+def test_failover_keeps_slot_sessions_bitwise_identical():
+    engines = []
+    for _ in range(3):
+        _pol, _params, e, _rng = _build("lstm", seed=0)
+        e.enable_slots(4)
+        engines.append(e)
+    rng = np.random.default_rng(7)
+    steps = [
+        rng.standard_normal((2, OBS_DIM)).astype(np.float32)
+        for _ in range(6)
+    ]
+    # unfailed single-engine reference over the same per-session stream
+    _pol, _params, ref_eng, _r = _build("lstm", seed=0)
+    ref_eng.enable_slots(4)
+    ref = [ref_eng.decide_batch_slots(s, ["a", "b"]) for s in steps]
+
+    store = SessionStateStore()
+    fleet = _slot_fleet(engines[:2], engines[2], store)
+    try:
+        got = []
+        for t in range(3):
+            futs = [
+                fleet.submit(steps[t][i], session=s)
+                for i, s in enumerate(["a", "b"])
+            ]
+            got.append([f.result(30) for f in futs])
+        victim = store.replica("a")
+        assert victim is not None
+        res = fleet.fail_over(victim)
+        assert res["verified"] is True
+        assert res["mirror_flushed"] >= 1  # device slots reached the store
+        for t in range(3, 6):
+            futs = [
+                fleet.submit(steps[t][i], session=s)
+                for i, s in enumerate(["a", "b"])
+            ]
+            got.append([f.result(30) for f in futs])
+        for t in range(6):
+            for i in range(2):
+                _assert_bitwise(
+                    got[t][i].actor_out, ref[t].actor_out[i],
+                    f"failover t={t} row={i}",
+                )
+                _assert_bitwise(
+                    got[t][i].action, ref[t].action[i],
+                    f"failover t={t} row={i}",
+                )
+    finally:
+        fleet.close()
+
+
+def test_flaky_fleet_reroutes_slot_faults():
+    engines = []
+    for _ in range(3):
+        _pol, _params, e, _rng = _build("lstm", seed=0)
+        e.enable_slots(4)
+        engines.append(e)
+    wrapped = [FlakyEngine(e, plan=()) for e in engines[:2]]
+    store = SessionStateStore()
+    fleet = _slot_fleet(wrapped, engines[2], store)
+    try:
+        rng = np.random.default_rng(8)
+        row = rng.standard_normal(OBS_DIM).astype(np.float32)
+        d0 = fleet.submit(row, session="a").result(30)
+        pinned = fleet.replica(store.replica("a"))
+        pinned.engine.push_faults("exc")
+        d1 = fleet.submit(row, session="a").result(30)  # re-routed
+        assert d1.action.shape == d0.action.shape
+        assert pinned.engine.faults_injected == 1
+    finally:
+        fleet.close()
+
+
+def test_bluegreen_promote_rollback_preserves_slot_streams():
+    from gymfx_tpu.train.checkpoint import save_checkpoint
+
+    pol, p0, active, rng = _build("lstm", seed=0)
+    active.enable_slots(4)
+    _pol2, _p, standby, _r = _build("lstm", seed=0)
+    standby.enable_slots(4)
+    example = np.zeros(OBS_DIM, np.float32)
+    carry0 = pol.initial_carry(())
+    p1 = pol.init(jax.random.PRNGKey(9), jnp.asarray(example), carry0)
+
+    steps = [
+        rng.standard_normal((2, OBS_DIM)).astype(np.float32)
+        for _ in range(9)
+    ]
+    # reference: p0 for steps 0-2, p1 for 3-5, back to p0 for 6-8 — the
+    # session carries CONTINUE across both weight flips
+    _pol3, _p3, ref_eng, _r3 = _build("lstm", seed=0)
+    ref_eng.enable_slots(4)
+    ref = []
+    for t in range(3):
+        ref.append(ref_eng.decide_batch_slots(steps[t], ["a", "b"]))
+    ref_eng.swap_weights(p1)
+    for t in range(3, 6):
+        ref.append(ref_eng.decide_batch_slots(steps[t], ["a", "b"]))
+    ref_eng.swap_weights(p0)
+    for t in range(6, 9):
+        ref.append(ref_eng.decide_batch_slots(steps[t], ["a", "b"]))
+
+    batcher = MicroBatcher(active, max_batch_wait_ms=1.0, pipeline=True)
+    dep = BlueGreenDeployer(active, standby, batcher=batcher)
+
+    def run(t):
+        futs = [
+            batcher.submit(steps[t][i], session=s)
+            for i, s in enumerate(["a", "b"])
+        ]
+        return [f.result(30) for f in futs]
+
+    try:
+        got = [run(t) for t in range(3)]
+        with tempfile.TemporaryDirectory() as d:
+            save_checkpoint(d, p1, step=7)
+            dep.promote(d)
+            got += [run(t) for t in range(3, 6)]
+            rb = dep.rollback()
+            assert rb.verified is True
+            got += [run(t) for t in range(6, 9)]
+        for t in range(9):
+            for i in range(2):
+                _assert_bitwise(
+                    got[t][i].actor_out, ref[t].actor_out[i],
+                    f"bluegreen t={t} row={i}",
+                )
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# SlotCache unit surface
+
+
+def test_slot_cache_adopt_requires_matching_capacity():
+    carry0 = {"h": np.zeros(4, np.float32)}
+    a = SlotCache(2, carry0)
+    b = SlotCache(3, carry0)
+    with pytest.raises(ValueError):
+        a.adopt(b)
+
+
+def test_slot_cache_rejects_empty_carry_and_zero_slots():
+    with pytest.raises(ValueError):
+        SlotCache(0, {"h": np.zeros(2, np.float32)})
+    with pytest.raises(ValueError):
+        SlotCache(2, ())
+
+
+def test_engine_dispatch_resolve_is_idempotent():
+    _pol, _params, eng, rng = _build("lstm", buckets=(1, 2))
+    eng.enable_slots(2)
+    obs = _rows(rng, eng, 1)
+    h = eng.dispatch_async(obs, sessions=["i0"])
+    d1 = h.resolve()
+    d2 = h.resolve()
+    assert d1 is d2
+
+
+# ---------------------------------------------------------------------------
+# satellite: copy_carry_owned copies only aliasing leaves (opt-in adopt)
+
+
+def test_copy_carry_owned_skips_owned_arrays():
+    owned = np.arange(8, dtype=np.float32)
+    base = np.arange(16, dtype=np.float32)
+    view = base[:8]
+    tree, copied, avoided = copy_carry_owned(
+        {"o": owned, "v": view}, adopt=True
+    )
+    assert copied == 1 and avoided == 1
+    assert tree["o"] is owned  # adopted, not copied
+    assert tree["v"].base is None  # view was materialized
+    _assert_bitwise(tree["v"], view, "view copy")
+    # without the opt-in, flags never justify adoption: a fresh owned
+    # array may still be the caller's buffer
+    tree2, copied2, avoided2 = copy_carry_owned({"o": owned, "v": view})
+    assert copied2 == 2 and avoided2 == 0
+    assert tree2["o"] is not owned
+
+
+def test_session_store_counts_copies_avoided():
+    store = SessionStateStore()
+    owned = np.arange(4, dtype=np.float32)
+    store.record_decision("s", {"h": owned}, owned=True)
+    assert store.carry_copies_avoided == 1 and store.carry_copies == 0
+    base = np.arange(8, dtype=np.float32)
+    store.record_decision("s", {"h": base[:4]}, owned=True)
+    assert store.carry_copies == 1
+    # default records stay fully copied — the public contract
+    store.record_decision("s", {"h": owned})
+    assert store.carry_copies == 2
+    assert store.carry("s")["h"] is not owned
+    # the stored tree never aliases caller memory
+    base[:4] = -1.0
+    _assert_bitwise(
+        store.carry("s")["h"], np.arange(4, dtype=np.float32), "no alias"
+    )

@@ -396,6 +396,53 @@ with tempfile.TemporaryDirectory() as d:
 EOF
 echo "fleet-chaos quick (3 replicas, scripted kill): rc=$fleet_rc"
 
+# serve-load quick leg: the open-loop sustained-load harness over the
+# device-resident slot path (docs/serving.md, "Device-resident
+# sessions") must emit a schema-valid serve_load row with zero dropped
+# requests and a bitwise slot-vs-host-carry parity verdict
+serveload_rc=0
+env JAX_PLATFORMS=cpu python - <<'EOF' || serveload_rc=$?
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, "tools")
+from check_bench_contract import validate_record  # noqa: E402
+
+with tempfile.TemporaryDirectory() as d:
+    out = Path(d) / "serve_load_report.json"
+    run = subprocess.run(
+        [sys.executable, "tools/serve_load.py", "--quick",
+         "--policy", "lstm", "--session_slots", "8",
+         "--batch_mode", "exact", "--report", str(out)],
+        capture_output=True, text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if run.returncode != 0 or not out.exists():
+        print("serve_load CLI failed:", run.stdout[-2000:],
+              run.stderr[-2000:])
+        sys.exit(run.returncode or 1)
+    line = [ln for ln in run.stdout.splitlines() if ln.strip()][-1]
+    row = json.loads(line)
+    problems = validate_record(row)
+    if problems:
+        print("SERVE LOAD ROW SCHEMA VIOLATIONS:", *problems, sep="\n  ")
+        sys.exit(1)
+    report = json.loads(out.read_text(encoding="utf-8"))
+    assert validate_record(report) == [], "report diverged from row schema"
+    assert row["dropped"] == 0, row
+    assert row["slot_parity"] is True, row
+    assert row["served"] > 0, row
+    assert report["late_compiles"] == 0, report
+    assert report["pipeline"] is True, report
+    print(f"serve-load quick OK ({row['served']}/{row['offered']} served "
+          f"at {row['sustained_decisions_per_sec']}/s sustained, "
+          f"p99 {row['p99_ms']} ms, slot parity bitwise)")
+EOF
+echo "serve-load quick (open loop, slot path): rc=$serveload_rc"
+
 # telemetry smoke + PROGRESS row (registry/http/sink are jax-free:
 # this is sub-second and runs even when the suite failed, so the row
 # records the failure too)
@@ -461,5 +508,8 @@ if [ "$soak_rc" -ne 0 ]; then
 fi
 if [ "$fleet_rc" -ne 0 ]; then
     exit "$fleet_rc"
+fi
+if [ "$serveload_rc" -ne 0 ]; then
+    exit "$serveload_rc"
 fi
 exit "$smoke_rc"
