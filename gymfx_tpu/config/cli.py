@@ -94,6 +94,18 @@ def parse_args(argv=None):
     parser.add_argument("--fault_profile", type=str)
     parser.add_argument("--guard_max_consecutive_skips", type=int)
 
+    # elastic degraded-mesh training (docs/resilience.md, "Elastic
+    # training"): auto-resume on survivor meshes after device loss
+    parser.add_argument(
+        "--elastic_resume", action="store_true", default=None
+    )
+    parser.add_argument("--elastic_max_retries", type=int)
+    parser.add_argument("--elastic_backoff_s", type=float)
+    parser.add_argument(
+        "--elastic_shrink_policy", choices=["repartition", "reject"]
+    )
+    parser.add_argument("--checkpoint_keep", type=int)
+
     # pod-scale mesh (docs/performance.md, "Scaling out"); JSON axis
     # sizes, e.g. '{"data": 8}' or '{"data": 16, "model": 2}'
     parser.add_argument("--mesh_shape", type=str)

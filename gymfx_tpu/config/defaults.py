@@ -118,6 +118,29 @@ DEFAULT_VALUES = {
     # deterministic fault-injection profile for chaos tests, e.g.
     # "nan_bars=30-31;transport=http:503,http:503,ok;preempt_at=2;seed=7"
     "fault_profile": None,
+    # ---- elastic degraded-mesh training (docs/resilience.md,
+    # "Elastic training") — every knob below unset keeps today's code
+    # paths bitwise identical (pinned by tests/test_elastic.py) ----
+    # master switch: route the training entry through the elastic
+    # auto-resume controller (parallel/elastic.py run_elastic) — on
+    # device loss the mesh is re-planned over the survivors and the run
+    # resumes from the last digest-verified checkpoint
+    "elastic_resume": False,
+    # bounded retry budget: how many device-loss resumes before the
+    # error propagates (each retry shrinks the mesh further)
+    "elastic_max_retries": 2,
+    # host-side backoff between a device loss and its resume attempt
+    "elastic_backoff_s": 0.0,
+    # honor-or-reject when num_envs / pbt_population no longer divide
+    # the survivor mesh's data axis: "repartition" shrinks the data
+    # axis to the largest size that still divides the batch;
+    # "reject" raises ElasticReplanError instead of changing the
+    # env->shard mapping
+    "elastic_shrink_policy": "repartition",  # repartition | reject
+    # checkpoint retention: keep only the newest N step dirs (digest +
+    # empty-leaves sidecars pruned with them); 0 = keep everything.
+    # The step an active resume points at is never pruned.
+    "checkpoint_keep": 0,
 
     # ---- dispatch / memory (docs/performance.md) ----
     # superstep driver: fuse K train steps into one donated lax.scan

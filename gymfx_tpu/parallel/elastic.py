@@ -1,0 +1,481 @@
+"""Elastic degraded-mesh training: survive device loss without
+stranding the run (docs/resilience.md, "Elastic training").
+
+On a pod, preemption and chip loss are the steady state — yet a
+checkpoint written by ``ShardedRuntime`` resumes placement-identical,
+so losing one device used to strand the whole run.  This module gives
+training the discipline serving already has (serve/fleet.py
+``ReplicaSupervisor``): detect the loss, re-plan the mesh over the
+survivors, and re-enter the last digest-verified checkpoint against the
+NEW plan.
+
+  is_device_loss          classify an exception: the simulated
+                          :class:`DeviceLossError` (``mesh=`` fault
+                          grammar) or a real XLA runtime device error;
+  plan_survivor_shape     re-derive the mesh shape for the smaller
+                          topology — honor-or-reject when num_envs /
+                          the PBT population no longer divide the new
+                          data axis, with an explicit
+                          ``elastic_shrink_policy`` (repartition vs
+                          reject);
+  stream_preserving       whether a shrink keeps the env->shard mapping
+                          a pure coarsening (every new shard is a
+                          concatenation of whole old shards) — the case
+                          where per-env streams stay bitwise identical;
+  survivor_devices        the device list with the lost global indices
+                          excluded (what the survivor mesh forms over);
+  MeshSupervisor          tiny-dispatch health probes over the mesh
+                          devices, healthy/degraded/dead classification
+                          (mirrors serve's ReplicaSupervisor);
+  run_elastic             the bounded-retry auto-resume controller the
+                          trainers' ``train_from_config`` entries route
+                          through when ``elastic_resume`` is set.
+
+Cross-mesh resume path: the last good checkpoint is host-gathered
+through the existing digest-verified restore
+(train/checkpoint.py ``_restore_item`` verifies the sha256 sidecar and
+falls back to the newest verifying step), then re-enters the device
+mesh via ``ShardedRuntime.place_state`` against the survivor plan —
+the one NamedSharding plan, re-derived for the smaller topology.  When
+the repartition is stream-preserving, per-env trajectories continue
+bitwise identical (env math is element-wise per env; only the shard
+boundaries move).
+
+Every knob unset keeps today's paths bitwise identical — ``run_elastic``
+is only entered when ``elastic_resume`` is set, and an armed controller
+with no faults is a plain passthrough (pinned by tests/test_elastic.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gymfx_tpu.resilience.faults import (
+    DeviceLossError,
+    strip_fired_mesh_events,
+)
+
+# substrings (lowercased) that mark a real runtime error as device
+# loss: the XLA runtime and the PJRT C API surface chip/host failures
+# as RuntimeError/XlaRuntimeError with these status phrases
+DEVICE_LOSS_MARKERS = (
+    "device_unavailable",
+    "device unavailable",
+    "device lost",
+    "device or resource busy",
+    "failed to connect to",
+    "socket closed",
+    "halted execution",
+    "slice health",
+    "data transfer failure",
+)
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Whether an exception means "a device/host dropped out" — the
+    simulated :class:`DeviceLossError` directly, or a runtime error
+    whose message carries one of the known XLA device-failure phrases.
+    Everything else (OOM, a genuine bug, divergence) must propagate —
+    retrying those on a smaller mesh would only mask them."""
+    if isinstance(exc, DeviceLossError):
+        return True
+    if not isinstance(exc, RuntimeError):
+        return False
+    msg = str(exc).lower()
+    return any(marker in msg for marker in DEVICE_LOSS_MARKERS)
+
+
+class ElasticReplanError(RuntimeError):
+    """The survivor topology cannot honor the run's batch layout —
+    either no devices remain for the model axis, or
+    ``elastic_shrink_policy=reject`` forbids changing the env->shard
+    mapping that the new data axis would force."""
+
+
+def plan_survivor_shape(
+    shape: Dict[str, int],
+    *,
+    n_lost: int = 1,
+    must_divide: Sequence[int] = (),
+    policy: str = "repartition",
+    axis: str = "data",
+) -> Dict[str, int]:
+    """Re-derive the mesh shape after losing ``n_lost`` devices.
+
+    Non-batch axes (``model`` tensor parallelism) keep their size — the
+    wide-layer sharding plan depends on it — so the loss comes out of
+    the ``axis`` (data) extent: ``new_data = surviving // model_prod``.
+
+    Honor-or-reject: when any of ``must_divide`` (num_envs, the PBT
+    population) no longer divides the shrunk data axis,
+    ``policy="repartition"`` shrinks the data axis further to the
+    largest size every constraint divides by (re-partitioning the same
+    global batch over fewer shards), while ``policy="reject"`` raises
+    :class:`ElasticReplanError` — never a silent wrong layout.
+    """
+    if not shape:
+        raise ElasticReplanError("cannot re-plan an empty mesh shape")
+    if axis not in shape:
+        raise ElasticReplanError(
+            f"mesh shape {shape} has no {axis!r} axis to shrink"
+        )
+    if policy not in ("repartition", "reject"):
+        raise ValueError(
+            f"elastic_shrink_policy must be 'repartition' or 'reject', "
+            f"got {policy!r}"
+        )
+    sizes = {k: int(v) for k, v in shape.items()}
+    other = int(np.prod([v for k, v in sizes.items() if k != axis] or [1]))
+    total = int(np.prod(list(sizes.values())))
+    surviving = total - int(n_lost)
+    new_data = surviving // other
+    if new_data < 1:
+        raise ElasticReplanError(
+            f"{surviving} surviving device(s) cannot carry the "
+            f"non-{axis} axes of {shape} (need at least {other})"
+        )
+    constraints = [int(n) for n in must_divide if n]
+    if any(n % new_data for n in constraints):
+        if policy == "reject":
+            bad = [n for n in constraints if n % new_data]
+            raise ElasticReplanError(
+                f"survivor {axis} axis {new_data} does not divide "
+                f"{bad} and elastic_shrink_policy=reject forbids "
+                f"re-partitioning the env->shard mapping"
+            )
+        new_data = max(
+            d for d in range(1, new_data + 1)
+            if all(n % d == 0 for n in constraints)
+        )
+    out = dict(sizes)
+    out[axis] = new_data
+    return out
+
+
+def stream_preserving(
+    old_shape: Dict[str, int], new_shape: Dict[str, int], axis: str = "data"
+) -> bool:
+    """Whether shrinking ``old_shape`` -> ``new_shape`` keeps the
+    env->shard mapping a pure coarsening: same non-batch axes, and the
+    old data extent a whole multiple of the new one, so every new shard
+    is a concatenation of whole old shards (global env order unchanged,
+    per-env streams bitwise identical)."""
+    old = {k: int(v) for k, v in old_shape.items()}
+    new = {k: int(v) for k, v in new_shape.items()}
+    if set(old) != set(new):
+        return False
+    if any(old[k] != new[k] for k in old if k != axis):
+        return False
+    return new.get(axis, 0) > 0 and old.get(axis, 0) % new[axis] == 0
+
+
+def survivor_devices(lost: Sequence[int],
+                     devices: Optional[Sequence[Any]] = None) -> List[Any]:
+    """The device list with the lost GLOBAL indices removed — what the
+    survivor mesh forms over (``make_mesh(shape, devices=...)``)."""
+    import jax
+
+    pool = list(devices if devices is not None else jax.devices())
+    dead = {int(i) for i in lost}
+    return [d for i, d in enumerate(pool) if i not in dead]
+
+
+# ---------------------------------------------------------------------------
+class MeshSupervisor:
+    """Tiny-dispatch health probes over the training mesh's devices,
+    mirroring serve's :class:`~gymfx_tpu.serve.fleet.ReplicaSupervisor`:
+
+      dead      probe raised ``dead_after`` consecutive times, or the
+                device was marked lost by the fault grammar / elastic
+                controller;
+      degraded  at least one recent probe failure, not yet dead;
+      healthy   the probe round-tripped.
+
+    ``poll_once()`` is callable directly (no thread) — tests and the
+    chaos harness drive it deterministically; ``start()`` runs it on a
+    daemon thread every ``interval_s``.  The probe is one scalar
+    ``device_put`` + add per device — small enough to run at cadence
+    without perturbing training dispatches.
+
+    ``snapshot()`` feeds the ``gymfx_mesh_devices{state=...}`` gauges
+    (telemetry/registry.py ``register_mesh_health``) and the flight-
+    recorder postmortem frame; ``degrades`` counts mark_lost events
+    (the degrade counter).
+    """
+
+    def __init__(
+        self,
+        mesh: Any = None,
+        *,
+        devices: Optional[Sequence[Any]] = None,
+        interval_s: float = 5.0,
+        dead_after: int = 3,
+        probe: Optional[Callable[[Any], float]] = None,
+    ):
+        if devices is None:
+            if mesh is not None:
+                devices = list(np.asarray(mesh.devices).ravel())
+            else:
+                import jax
+
+                devices = list(jax.devices())
+        self.devices = list(devices)
+        self.interval_s = float(interval_s)
+        self.dead_after = max(1, int(dead_after))
+        self._probe = probe if probe is not None else self._default_probe
+        self._failures = [0] * len(self.devices)
+        self._lost: set = set()
+        self._lock = threading.Lock()
+        self.polls = 0
+        self.degrades = 0
+        self._stop = threading.Event()
+        self._started = False
+        self._thread = threading.Thread(
+            target=self._run, name="gymfx-mesh-supervisor", daemon=True
+        )
+
+    @staticmethod
+    def _default_probe(device: Any) -> float:
+        import jax
+
+        return float(
+            np.asarray(jax.device_put(np.float32(1.0), device) + 1.0)
+        )
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "MeshSupervisor":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._started:
+            self._thread.join(timeout)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                # a probe crash must never kill the supervision loop
+                pass
+
+    # -- probing -------------------------------------------------------
+    def mark_lost(self, indices: Sequence[int]) -> None:
+        """Record devices lost out-of-band (the ``mesh=`` fault grammar
+        or the elastic controller's classification of a real error) —
+        they classify dead without waiting out ``dead_after`` probes."""
+        with self._lock:
+            fresh = {int(i) for i in indices} - self._lost
+            if fresh:
+                self._lost |= fresh
+                self.degrades += 1
+
+    def poll_once(self) -> Dict[int, str]:
+        """Probe every device once; returns device index -> state."""
+        self.polls += 1
+        states: Dict[int, str] = {}
+        for i, device in enumerate(self.devices):
+            with self._lock:
+                if i in self._lost:
+                    states[i] = "dead"
+                    continue
+            try:
+                self._probe(device)
+            except Exception:
+                self._failures[i] += 1
+                states[i] = (
+                    "dead" if self._failures[i] >= self.dead_after
+                    else "degraded"
+                )
+            else:
+                self._failures[i] = 0
+                states[i] = "healthy"
+        return states
+
+    def classify(self) -> Dict[int, str]:
+        """Current classification WITHOUT dispatching probes (reads the
+        accumulated failure counts + out-of-band losses)."""
+        states: Dict[int, str] = {}
+        with self._lock:
+            lost = set(self._lost)
+        for i in range(len(self.devices)):
+            if i in lost or self._failures[i] >= self.dead_after:
+                states[i] = "dead"
+            elif self._failures[i] > 0:
+                states[i] = "degraded"
+            else:
+                states[i] = "healthy"
+        return states
+
+    def snapshot(self) -> Dict[str, int]:
+        """State histogram for the ``gymfx_mesh_devices{state}`` gauges."""
+        states = self.classify()
+        return {
+            "healthy": sum(1 for s in states.values() if s == "healthy"),
+            "degraded": sum(1 for s in states.values() if s == "degraded"),
+            "dead": sum(1 for s in states.values() if s == "dead"),
+        }
+
+
+# ---------------------------------------------------------------------------
+def _shape_of(config: Dict[str, Any]) -> Optional[Dict[str, int]]:
+    raw = config.get("mesh_shape")
+    if raw in (None, ""):
+        return None
+    if isinstance(raw, str):
+        import json
+
+        raw = json.loads(raw)
+    return {str(k): int(v) for k, v in dict(raw).items()}
+
+
+def _attempt_ledger_path(path: Any, attempt: int) -> str:
+    """``ledger.jsonl`` -> ``ledger.attempt2.jsonl``: each resume
+    attempt appends to its OWN ledger file, keeping every file's ``seq``
+    strictly monotonic (the schema contract) while the shared directory
+    still tells the whole story in attempt order."""
+    from pathlib import Path
+
+    p = Path(str(path))
+    return str(p.with_name(f"{p.stem}.attempt{int(attempt)}{p.suffix}"))
+
+
+def run_elastic(
+    train_once: Callable[[Dict[str, Any]], Dict[str, Any]],
+    config: Dict[str, Any],
+    *,
+    must_divide: Sequence[int] = (),
+    sleep: Callable[[float], None] = time.sleep,
+) -> Dict[str, Any]:
+    """The auto-resume controller: call ``train_once(cfg)`` and, on
+    device loss, re-plan + resume on the survivor mesh — bounded by
+    ``elastic_max_retries`` with ``elastic_backoff_s`` between attempts.
+
+    Each retry rewrites its config copy (the caller's dict is never
+    mutated):
+
+      * ``mesh_shape``      the survivor shape from
+                            :func:`plan_survivor_shape` (honor-or-reject
+                            per ``elastic_shrink_policy``);
+      * ``elastic_exclude_devices``  the lost global device indices, so
+                            ``mesh_from_config`` forms the mesh over the
+                            SURVIVORS, not the first N devices;
+      * ``resume_training`` True — the trainer's own resume entry
+                            host-gathers the last digest-verified
+                            checkpoint and re-enters it via
+                            ``place_state`` against the new plan;
+      * ``train_total_steps``  reduced by the steps already safely
+                            checkpointed, so the run finishes at the
+                            originally requested global step;
+      * ``fault_profile``   fired ``mesh=`` events stripped (the retry
+                            must not re-kill the device it lost);
+      * ``elastic_attempt`` the 1-based attempt index — the trainers
+                            ledger a ``mesh_resume`` row when set;
+      * ``telemetry_ledger``  re-pointed at a per-attempt file so each
+                            ledger keeps a monotonic ``seq``.
+
+    The returned summary carries an ``elastic`` audit block (attempts,
+    per-degrade history, final mesh shape) whenever a resume happened.
+    """
+    cfg = dict(config)
+    max_retries = int(cfg.get("elastic_max_retries", 2) or 0)
+    backoff_s = float(cfg.get("elastic_backoff_s", 0.0) or 0.0)
+    policy = str(cfg.get("elastic_shrink_policy") or "repartition")
+    base_ledger = cfg.get("telemetry_ledger") or None
+    history: List[Dict[str, Any]] = []
+    lost_total: List[int] = []
+    base_end: Optional[int] = None
+    attempt = 0
+    while True:
+        try:
+            summary = train_once(cfg)
+        except BaseException as exc:
+            if not is_device_loss(exc) or attempt >= max_retries:
+                raise
+            attempt += 1
+            lost = list(getattr(exc, "lost", ()) or (0,))
+            # offset the lost indices into GLOBAL device ids: a fault
+            # naming device 0 of an already-shrunk mesh must not evict
+            # global device 0 again
+            already = set(lost_total)
+            global_lost = []
+            for idx in lost:
+                alive = [
+                    g for g in range(len(already) + len(lost) + idx + 1024)
+                    if g not in already
+                ]
+                global_lost.append(alive[int(idx)])
+                already.add(alive[int(idx)])
+            lost_total.extend(global_lost)
+            shape = _shape_of(cfg)
+            if shape is None:
+                raise ElasticReplanError(
+                    "elastic_resume needs an explicit mesh_shape to "
+                    "re-plan over survivors"
+                ) from exc
+            new_shape = plan_survivor_shape(
+                shape, n_lost=len(lost), must_divide=must_divide,
+                policy=policy,
+            )
+            ckpt_step = getattr(exc, "checkpoint_step", None)
+            if base_end is None:
+                base_end = (
+                    int(getattr(exc, "step_offset", 0) or 0)
+                    + int(cfg.get("train_total_steps", 0) or 0)
+                )
+            history.append({
+                "attempt": attempt,
+                "lost": [int(i) for i in global_lost],
+                "at": getattr(exc, "at", None),
+                "checkpoint_step": ckpt_step,
+                "mesh_shape": dict(new_shape),
+                "stream_preserving": stream_preserving(shape, new_shape),
+            })
+            cfg = dict(cfg)
+            cfg["mesh_shape"] = dict(new_shape)
+            cfg["elastic_exclude_devices"] = [int(i) for i in lost_total]
+            cfg["resume_training"] = True
+            cfg["elastic_attempt"] = attempt
+            if ckpt_step is not None:
+                cfg["train_total_steps"] = max(1, base_end - int(ckpt_step))
+            at = getattr(exc, "at", None)
+            if at is not None:
+                cfg["fault_profile"] = strip_fired_mesh_events(
+                    cfg.get("fault_profile"), int(at)
+                )
+            if base_ledger:
+                cfg["telemetry_ledger"] = _attempt_ledger_path(
+                    base_ledger, attempt
+                )
+            if backoff_s > 0:
+                sleep(backoff_s * attempt)
+            continue
+        if history:
+            summary = dict(summary)
+            summary["elastic"] = {
+                "attempts": attempt,
+                "degrades": history,
+                "mesh_shape": _shape_of(cfg),
+                "lost_devices": [int(i) for i in lost_total],
+            }
+        return summary
+
+
+def elastic_entry(
+    train_once: Callable[[Dict[str, Any]], Dict[str, Any]],
+    config: Dict[str, Any],
+    *,
+    must_divide: Sequence[int] = (),
+) -> Dict[str, Any]:
+    """The trainers' one-line gate: route through :func:`run_elastic`
+    only when ``elastic_resume`` is set — unset, the call IS
+    ``train_once(config)``, bitwise-identical to the pre-elastic path."""
+    if not config.get("elastic_resume"):
+        return train_once(config)
+    return run_elastic(train_once, config, must_divide=must_divide)

@@ -89,6 +89,11 @@ def mesh_from_config(config: Dict) -> Optional[Mesh]:
     cannot be realized on the available devices — never silently ignores
     the field.  ``n_envs`` divisibility is validated by the trainers
     (they know their batch axis).
+
+    ``elastic_exclude_devices`` (written by the elastic auto-resume
+    controller, parallel/elastic.py) lists GLOBAL device indices lost to
+    degrade events — the mesh forms over the survivors, not the first N
+    devices, so a resume attempt never lands work back on a dead chip.
     """
     raw = config.get("mesh_shape")
     if raw is None or raw == "":
@@ -117,6 +122,25 @@ def mesh_from_config(config: Dict) -> Optional[Mesh]:
         if not ok:
             raise ValueError(f"mesh_shape[{axis!r}] must be a positive int, got {size!r}")
         shape[axis] = size_i
+    exclude = config.get("elastic_exclude_devices") or ()
+    if exclude:
+        dead = set()
+        for idx in exclude:
+            try:
+                idx_i = int(idx)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"elastic_exclude_devices entries must be device "
+                    f"indices, got {idx!r}"
+                )
+            if idx_i < 0:
+                raise ValueError(
+                    f"elastic_exclude_devices entries must be >= 0, "
+                    f"got {idx_i}"
+                )
+            dead.add(idx_i)
+        survivors = [d for i, d in enumerate(jax.devices()) if i not in dead]
+        return make_mesh(shape, devices=survivors)
     return make_mesh(shape)
 
 
@@ -172,17 +196,73 @@ def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(axis))
 
 
+class CoordinatorTimeoutError(TimeoutError):
+    """Multi-host initialization exhausted its retry budget without
+    reaching the coordinator — carries the address and attempt count so
+    the launcher can tell "coordinator never came up" apart from a
+    generic hang."""
+
+    def __init__(self, coordinator_address: str, attempts: int,
+                 cause: Optional[BaseException] = None):
+        super().__init__(
+            f"could not reach coordinator {coordinator_address!r} after "
+            f"{attempts} attempt(s): {cause}"
+        )
+        self.coordinator_address = coordinator_address
+        self.attempts = attempts
+        self.cause = cause
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    retries: int = 3,
+    backoff_s: float = 2.0,
+    timeout_s: Optional[float] = None,
+    _initialize=None,
+    _sleep=None,
 ) -> None:
     """Multi-host (DCN) initialization; single-process no-op when no
-    coordinator is configured."""
+    coordinator is configured.
+
+    At pod scale the coordinator host routinely comes up seconds after
+    its workers, so a bare ``jax.distributed.initialize`` races boot
+    order.  The attempt is bounded: ``retries`` tries with linear
+    ``backoff_s`` between them, each passing ``initialization_timeout``
+    through where the jax version supports it, and the budget exhausting
+    raises :class:`CoordinatorTimeoutError` instead of a raw
+    RuntimeError, so launchers can distinguish "coordinator never came
+    up" from a real init bug.  ``_initialize``/``_sleep`` are test
+    seams (default: the real jax call / time.sleep).
+    """
     if coordinator_address is None:
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    import time as _time
+
+    init = _initialize if _initialize is not None else jax.distributed.initialize
+    sleep = _sleep if _sleep is not None else _time.sleep
+    attempts = max(1, int(retries))
+    last: Optional[BaseException] = None
+    for attempt in range(1, attempts + 1):
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        try:
+            if timeout_s is not None:
+                try:
+                    init(initialization_timeout=int(timeout_s), **kwargs)
+                except TypeError:
+                    # older jax: no initialization_timeout kwarg
+                    init(**kwargs)
+            else:
+                init(**kwargs)
+            return
+        except (RuntimeError, ConnectionError, TimeoutError) as exc:
+            last = exc
+            if attempt < attempts:
+                sleep(backoff_s * attempt)
+    raise CoordinatorTimeoutError(coordinator_address, attempts, last)

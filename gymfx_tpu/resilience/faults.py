@@ -45,6 +45,16 @@ Profile grammar — semicolon-separated ``key=value`` clauses::
                         ``flap:2@6`` makes replica 2 throw transient
                         dispatch errors at decision 6 then recover
                         (consumed by tools/fleet_chaos.py)
+  mesh                  ``+``-joined TRAINING-mesh fault events of the
+                        form ``kill:<device>@<superstep>`` —
+                        ``kill:3@2`` marks mesh device 3 lost at the
+                        first superstep boundary reaching iteration 2;
+                        the trainer loop raises DeviceLossError after
+                        ledgering a ``mesh_degrade`` row and dumping
+                        the flight recorder, and the elastic runtime
+                        (parallel/elastic.py) re-plans a survivor mesh
+                        and auto-resumes from the last checkpoint
+                        (consumed by tools/elastic_chaos.py)
   preempt_at            iteration index after which the trainer raises
                         SimulatedPreemptionError (checkpoint drill)
   scengen               a scengen preset name (``scengen=flash_crash``):
@@ -97,9 +107,49 @@ FLEET_FAULT_ACTIONS = (
 )
 
 
+MESH_FAULT_ACTIONS = (
+    "kill",     # mark a mesh device lost: the trainer loop aborts with
+                # DeviceLossError at the superstep boundary and the
+                # elastic runtime re-plans over the survivors
+)
+
+
 class InjectedDispatchError(RuntimeError):
     """Injected engine-dispatch failure (the serving chaos harness's
     stand-in for an XLA runtime error / device loss mid-dispatch)."""
+
+
+class DeviceLossError(RuntimeError):
+    """A mesh device (or host) was lost mid-training — real XLA device
+    errors are re-classified into this type by
+    :func:`gymfx_tpu.parallel.elastic.is_device_loss`; the simulated
+    ``mesh=`` fault grammar raises it directly from the trainer loop.
+
+    Carries everything the elastic auto-resume controller needs to
+    re-plan and resume: the lost device indices, the superstep boundary
+    the loss surfaced at, the last checkpoint step that made it to disk
+    (None = nothing checkpointed yet, the retry cold-starts), and the
+    step offset the dying run started from."""
+
+    def __init__(self, lost: Sequence[int], at: Optional[int] = None,
+                 checkpoint_step: Optional[int] = None,
+                 step_offset: int = 0):
+        lost_t = tuple(int(d) for d in lost)
+        super().__init__(
+            f"mesh device(s) {list(lost_t)} lost"
+            + (f" at superstep {int(at)}" if at is not None else "")
+            + (
+                f"; last good checkpoint at step {int(checkpoint_step)}"
+                if checkpoint_step is not None
+                else "; no checkpoint written yet"
+            )
+        )
+        self.lost = lost_t
+        self.at = None if at is None else int(at)
+        self.checkpoint_step = (
+            None if checkpoint_step is None else int(checkpoint_step)
+        )
+        self.step_offset = int(step_offset or 0)
 
 
 class FlakyEngine:
@@ -439,6 +489,58 @@ def _parse_fleet_token(tok: str) -> Dict[str, Any]:
     return {"action": action, "replica": replica, "at": at, "ms": ms}
 
 
+def _parse_mesh_token(tok: str) -> Dict[str, Any]:
+    """Parse one mesh fault event ``kill:<device>@<superstep>``."""
+    action, sep, rest = tok.partition(":")
+    if action not in MESH_FAULT_ACTIONS or not sep:
+        raise ValueError(
+            f"mesh fault token {tok!r} must start with one of "
+            f"{MESH_FAULT_ACTIONS} followed by ':<device>@<superstep>'"
+        )
+    device_s, at_sep, at_s = rest.partition("@")
+    if not at_sep:
+        raise ValueError(f"mesh fault token {tok!r} is missing '@<superstep>'")
+    try:
+        device, at = int(device_s), int(at_s)
+    except ValueError:
+        raise ValueError(
+            f"mesh fault token {tok!r}: device and superstep must be ints"
+        ) from None
+    if device < 0 or at < 0:
+        raise ValueError(
+            f"mesh fault token {tok!r}: device and superstep index "
+            "must be >= 0"
+        )
+    return {"action": action, "device": device, "at": at}
+
+
+def strip_fired_mesh_events(spec: Optional[str],
+                            fired_at: int) -> Optional[str]:
+    """Rewrite a fault-profile string with every ``mesh=`` event whose
+    ``at`` index is <= ``fired_at`` removed — how the elastic auto-
+    resume controller keeps a retried run from re-killing the device
+    it already lost.  Non-mesh clauses pass through verbatim; a mesh
+    clause with no surviving events is dropped entirely."""
+    if not spec:
+        return spec
+    clauses: List[str] = []
+    for clause in str(spec).split(";"):
+        stripped = clause.strip()
+        if not stripped:
+            continue
+        key, sep, val = stripped.partition("=")
+        if sep and key.strip() == "mesh":
+            keep = [
+                tok for tok in val.replace(",", "+").split("+")
+                if tok and _parse_mesh_token(tok)["at"] > int(fired_at)
+            ]
+            if keep:
+                clauses.append(f"mesh={'+'.join(keep)}")
+            continue
+        clauses.append(stripped)
+    return ";".join(clauses)
+
+
 def _parse_bars(spec: str) -> List[int]:
     spec = spec.strip()
     if "-" in spec:
@@ -457,6 +559,8 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
          "burst": {"size": int, "rounds": int}|None,
          "fleet": [{"action": str, "replica": int, "at": int,
                     "ms": float|None}, ...]  (sorted by "at"),
+         "mesh": [{"action": str, "device": int, "at": int}, ...]
+                  (sorted by "at"),
          "preempt_at": int|None, "seed": int}
 
     Empty/None spec parses to an all-inert profile; unknown clause keys
@@ -472,6 +576,7 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
         "serve_rate": 0.0,
         "burst": None,
         "fleet": [],
+        "mesh": [],
         "preempt_at": None,
         "scengen": None,
         "seed": 0,
@@ -523,6 +628,10 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
             for tok in [t for t in val.replace(",", "+").split("+") if t]:
                 profile["fleet"].append(_parse_fleet_token(tok))
             profile["fleet"].sort(key=lambda ev: ev["at"])
+        elif key == "mesh":
+            for tok in [t for t in val.replace(",", "+").split("+") if t]:
+                profile["mesh"].append(_parse_mesh_token(tok))
+            profile["mesh"].sort(key=lambda ev: ev["at"])
         elif key == "preempt_at":
             profile["preempt_at"] = int(val)
         elif key == "scengen":
@@ -538,7 +647,7 @@ def parse_fault_profile(spec: Optional[str]) -> Dict[str, Any]:
             raise ValueError(
                 f"unknown fault_profile key {key!r}; known: nan_bars, "
                 "inf_bars, fields, transport, serve, burst, fleet, "
-                "preempt_at, scengen, seed"
+                "mesh, preempt_at, scengen, seed"
             )
     return profile
 

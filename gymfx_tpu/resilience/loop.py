@@ -19,7 +19,10 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from gymfx_tpu.resilience.faults import SimulatedPreemptionError
+from gymfx_tpu.resilience.faults import (
+    DeviceLossError,
+    SimulatedPreemptionError,
+)
 from gymfx_tpu.resilience.guards import (
     NonFiniteDivergenceError,
     SkipMonitor,
@@ -55,6 +58,9 @@ class ResilientLoop:
         ledger: Any = None,
         recorder: Any = None,
         profiler: Any = None,
+        mesh_faults: Tuple[Dict[str, Any], ...] = (),
+        supervisor: Any = None,
+        checkpoint_keep: int = 0,
     ):
         self.steps_per_iter = int(steps_per_iter)
         self.checkpoint_dir = str(checkpoint_dir) if checkpoint_dir else None
@@ -81,6 +87,21 @@ class ResilientLoop:
         # owns the cadence — begin_superstep opens the trace window,
         # after_superstep closes it and writes the capture bundle
         self.profiler = profiler
+        # simulated device loss (fault grammar ``mesh=`` clause,
+        # docs/resilience.md "Elastic training"): each event fires at
+        # the first superstep boundary reaching its ``at`` iteration —
+        # ledger mesh_degrade, flight-recorder dump, then
+        # DeviceLossError for the elastic controller to classify
+        self._mesh_faults = sorted(
+            (dict(f) for f in mesh_faults), key=lambda f: int(f["at"])
+        )
+        # MeshSupervisor (parallel/elastic.py): told about scripted
+        # losses so the gymfx_mesh_devices{state} gauges and the degrade
+        # counter move even on CPU virtual meshes where probes still pass
+        self.supervisor = supervisor
+        # newest-N checkpoint retention (0 = keep everything); the
+        # resume-entry step is always protected
+        self.checkpoint_keep = int(checkpoint_keep or 0)
         self.last_checkpoint_step: Optional[int] = None
         # (it_start, k, guard metrics) — scalars for k == 1, stacked
         # (k,) arrays for a fused superstep
@@ -103,6 +124,7 @@ class ResilientLoop:
         save_checkpoint(
             self.checkpoint_dir, state_dict, step=step,
             metadata=self.checkpoint_metadata, params=params,
+            keep=self.checkpoint_keep, protect=(self.step_offset,),
         )
         self.last_checkpoint_step = step
         if self.ledger is not None:
@@ -194,6 +216,32 @@ class ResilientLoop:
             and it_end // self.checkpoint_every > it_start // self.checkpoint_every
         ):
             self._save(state_fn, self.step_offset + it_end * self.steps_per_iter)
+        if self._mesh_faults and int(self._mesh_faults[0]["at"]) <= it_end:
+            due = [f for f in self._mesh_faults if int(f["at"]) <= it_end]
+            self._mesh_faults = [
+                f for f in self._mesh_faults if int(f["at"]) > it_end
+            ]
+            lost = sorted({int(f["device"]) for f in due})
+            self._flush_loggers()
+            if self.supervisor is not None:
+                try:
+                    self.supervisor.mark_lost(lost)
+                except Exception:
+                    pass
+            if self.ledger is not None:
+                self.ledger.record(
+                    "mesh_degrade", lost=lost, at=int(it_end),
+                    checkpoint_step=self.last_checkpoint_step,
+                )
+            if self.recorder is not None:
+                self.recorder.dump(
+                    "device_loss", extra={"lost": lost, "at": int(it_end)}
+                )
+            raise DeviceLossError(
+                lost, at=int(it_end),
+                checkpoint_step=self.last_checkpoint_step,
+                step_offset=self.step_offset,
+            )
         if self.preempt_at is not None and it_end >= self.preempt_at:
             self._flush_loggers()
             if self.ledger is not None:

@@ -48,6 +48,7 @@ from gymfx_tpu.telemetry.registry import (  # noqa: F401
     Histogram,
     MetricsRegistry,
     global_registry,
+    register_mesh_health,
     register_resilience,
     resilience_snapshot,
 )
@@ -99,6 +100,7 @@ __all__ = [
     "get_active_ledger",
     "global_registry",
     "null_tracer",
+    "register_mesh_health",
     "register_resilience",
     "resilience_snapshot",
     "set_active_ledger",

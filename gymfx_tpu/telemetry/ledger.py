@@ -54,6 +54,8 @@ EVENT_KINDS = (
     "replica_down",
     "replica_failover",
     "curriculum_pick",
+    "mesh_degrade",
+    "mesh_resume",
 )
 
 

@@ -351,6 +351,40 @@ def register_resilience(
         g3.set_function(lambda b=breaker: float(b.failures), name=name)
 
 
+def register_mesh_health(
+    registry: MetricsRegistry,
+    supervisor: Any,
+    *,
+    name: str = "train",
+) -> None:
+    """Bind a :class:`~gymfx_tpu.parallel.elastic.MeshSupervisor` into
+    ``registry`` as callback gauges (same idiom as
+    :func:`register_resilience` — the gauges read the LIVE supervisor,
+    nothing is mirrored):
+
+      gymfx_mesh_devices{state=healthy|degraded|dead}
+          device counts from the supervisor's probe classification;
+      gymfx_mesh_degrades_total{name=...}
+          degrade events (devices marked lost) since run start.
+    """
+    g = registry.gauge(
+        "gymfx_mesh_devices",
+        "Mesh devices by health state (MeshSupervisor classification)",
+        labels=("state",),
+    )
+    for state in ("healthy", "degraded", "dead"):
+        g.set_function(
+            lambda s=supervisor, st=state: float(s.snapshot()[st]),
+            state=state,
+        )
+    g2 = registry.gauge(
+        "gymfx_mesh_degrades_total",
+        "Mesh degrade events (devices marked lost) since run start",
+        labels=("name",),
+    )
+    g2.set_function(lambda s=supervisor: float(s.degrades), name=name)
+
+
 def resilience_snapshot(registry: MetricsRegistry) -> Dict[str, Any]:
     """The ``gymfx_resilience_*`` slice of the registry as plain floats,
     merged into ``/healthz`` and ``MicroBatcher.health()`` consumers so

@@ -177,10 +177,12 @@ def audit_checkpoint_tree(directory: str) -> List[Dict[str, Any]]:
     restore, no tensor I/O beyond hashing bytes.  One row per step (and
     per ORPHANED digest sidecar whose step dir is gone):
 
-        {"step", "verified", "legacy", "digest", "files"}
+        {"step", "verified", "legacy", "digest", "files", "bytes"}
 
     ``legacy`` marks steps saved before the digest format (no sidecar;
-    verified=True by the restore-path contract).  The operator CLI is
+    verified=True by the restore-path contract).  ``bytes`` is the
+    step's on-disk footprint including its sidecars — what retention
+    (:func:`prune_checkpoints`) would reclaim.  The operator CLI is
     ``tools/checkpoint_audit.py``."""
     path = Path(directory).resolve()
     steps = _list_steps(path)
@@ -197,6 +199,7 @@ def audit_checkpoint_tree(directory: str) -> List[Dict[str, Any]]:
             rows.append({
                 "step": step, "verified": True, "legacy": True,
                 "digest": None, "files": None,
+                "bytes": _step_bytes(path, step),
             })
             continue
         try:
@@ -209,8 +212,72 @@ def audit_checkpoint_tree(directory: str) -> List[Dict[str, Any]]:
             "legacy": False,
             "digest": recorded.get("digest"),
             "files": recorded.get("files"),
+            "bytes": _step_bytes(path, step),
         })
     return rows
+
+
+def _step_bytes(path: Path, step: int) -> int:
+    """Disk footprint of one step: the step directory's files plus the
+    digest/empty-leaves sidecars that belong to it."""
+    total = 0
+    step_dir = path / str(int(step))
+    if step_dir.is_dir():
+        total += sum(
+            f.stat().st_size for f in step_dir.rglob("*") if f.is_file()
+        )
+    for sidecar in (
+        _digest_sidecar(path, step),
+        path / f"empty_leaves_{int(step)}.json",
+    ):
+        if sidecar.exists():
+            total += sidecar.stat().st_size
+    return total
+
+
+def prune_checkpoints(
+    directory: str,
+    keep: int,
+    protect: Tuple[int, ...] = (),
+) -> List[Dict[str, Any]]:
+    """Newest-N retention: delete every checkpoint step older than the
+    newest ``keep``, SIDECARS INCLUDED (``digest_<step>.json`` and
+    ``empty_leaves_<step>.json`` go with their step — an orphaned digest
+    would read as corruption in the audit).
+
+    ``keep <= 0`` keeps everything (the default posture).  Steps in
+    ``protect`` are never pruned regardless of age — the resume entry
+    step stays restorable while the resumed run is still writing newer
+    checkpoints on top of it.  Returns one ``{"step", "bytes"}`` row per
+    pruned step (bytes as measured before deletion).
+    """
+    import shutil
+
+    if int(keep) <= 0:
+        return []
+    path = Path(directory).resolve()
+    steps = _list_steps(path)
+    keep_set = set(steps[-int(keep):]) | {int(s) for s in protect}
+    pruned: List[Dict[str, Any]] = []
+    for step in steps:
+        if step in keep_set:
+            continue
+        size = _step_bytes(path, step)
+        shutil.rmtree(path / str(step), ignore_errors=True)
+        for sidecar in (
+            _digest_sidecar(path, step),
+            path / f"empty_leaves_{step}.json",
+        ):
+            try:
+                sidecar.unlink()
+            except OSError:
+                pass
+        pruned.append({"step": step, "bytes": size})
+        logger.info(
+            "pruned checkpoint step %d under %s (%d bytes, keep=%d)",
+            step, path, size, keep,
+        )
+    return pruned
 
 
 def _is_empty(x: Any) -> bool:
@@ -272,6 +339,8 @@ def save_checkpoint(
     step: int = 0,
     metadata: Optional[Dict[str, Any]] = None,
     params: Optional[Any] = None,
+    keep: int = 0,
+    protect: Tuple[int, ...] = (),
 ) -> str:
     """Save a checkpoint at ``step``.
 
@@ -280,6 +349,10 @@ def save_checkpoint(
     bare pytree (params-only saves).  Orbax silently skips a step that
     already exists — in that case the metadata is left untouched too,
     so it can never describe a tree that was not actually stored.
+
+    ``keep > 0`` applies newest-N retention AFTER the new step lands
+    (:func:`prune_checkpoints`; ``protect`` steps are exempt), so the
+    directory never transiently holds fewer than ``keep`` good steps.
     """
     path = Path(directory).resolve()
     path.mkdir(parents=True, exist_ok=True)
@@ -319,6 +392,8 @@ def save_checkpoint(
         _atomic_write_text(
             path / "metadata.json", json.dumps(metadata, indent=2)
         )
+    if int(keep) > 0:
+        prune_checkpoints(str(path), keep, protect=protect)
     return str(path)
 
 

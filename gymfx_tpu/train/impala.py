@@ -517,7 +517,9 @@ class ImpalaTrainer:
               max_consecutive_skips: int = 10,
               preempt_at: Optional[int] = None,
               supersteps_per_dispatch: int = 1,
-              telemetry=None):
+              telemetry=None,
+              mesh_faults=(),
+              checkpoint_keep: int = 0):
         if initial_state is not None:
             state = initial_state
             if self.runtime is not None:
@@ -547,6 +549,13 @@ class ImpalaTrainer:
             )
         else:
             logger = DelayedLogger("impala", log_every, iters)
+        # mesh health supervision (see PPOTrainer.train): only when a
+        # mesh exists AND something observes it
+        supervisor = None
+        if self.runtime is not None and (mesh_faults or telemetry is not None):
+            from gymfx_tpu.parallel.elastic import MeshSupervisor
+
+            supervisor = MeshSupervisor(self.runtime.mesh)
         hooks = ResilientLoop(
             steps_per_iter=per_iter,
             checkpoint_dir=checkpoint_dir,
@@ -561,7 +570,14 @@ class ImpalaTrainer:
             ledger=telemetry.ledger if telemetry is not None else None,
             recorder=telemetry.recorder if telemetry is not None else None,
             profiler=telemetry.profiler if telemetry is not None else None,
+            mesh_faults=tuple(mesh_faults or ()),
+            supervisor=supervisor,
+            checkpoint_keep=int(checkpoint_keep or 0),
         )
+        if telemetry is not None and supervisor is not None:
+            from gymfx_tpu.telemetry import register_mesh_health
+
+            register_mesh_health(telemetry.registry, supervisor, name="impala")
         if telemetry is not None and telemetry.profiler is not None:
             from gymfx_tpu.train.common import profiler_workload
 
@@ -638,6 +654,18 @@ class ImpalaTrainer:
 
 
 def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """CLI entry; with ``elastic_resume`` set the run routes through the
+    elastic auto-resume controller (parallel/elastic.py, see
+    train/ppo.py train_from_config)."""
+    from gymfx_tpu.parallel.elastic import elastic_entry
+
+    return elastic_entry(
+        _train_impala_from_config, config,
+        must_divide=(int(config.get("num_envs", 256) or 256),),
+    )
+
+
+def _train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.train.common import build_train_eval_envs
 
     env, eval_env = build_train_eval_envs(config)
@@ -669,6 +697,14 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     if telemetry is not None and telemetry.ledger is not None and (
             resume_state is not None or resume_params is not None):
         telemetry.ledger.record("checkpoint_restore", step=int(resume_step))
+        if config.get("elastic_attempt"):
+            # elastic re-entry: digest-verified restore re-entering the
+            # SURVIVOR mesh plan (see train/ppo.py)
+            telemetry.ledger.record(
+                "mesh_resume", step=int(resume_step),
+                attempt=int(config["elastic_attempt"]), verified=True,
+                mesh_shape=dict(mesh.shape) if mesh is not None else None,
+            )
     try:
         state, train_metrics = trainer.train(
             total, seed=int(config.get("seed", 0) or 0),
@@ -686,6 +722,8 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
             ),
             preempt_at=profile.get("preempt_at"),
             telemetry=telemetry,
+            mesh_faults=profile.get("mesh") or (),
+            checkpoint_keep=int(config.get("checkpoint_keep", 0) or 0),
         )
     except BaseException:
         # abort paths (preemption drill, divergence) still seal the run
@@ -730,6 +768,8 @@ def train_impala_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
                 metadata={"policy": icfg.policy,
                           "policy_kwargs": dict(icfg.policy_kwargs)},
                 params=state.learner_params,
+                keep=int(config.get("checkpoint_keep", 0) or 0),
+                protect=(int(resume_step),),
             )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

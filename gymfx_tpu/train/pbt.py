@@ -305,6 +305,22 @@ def _pbt_config_from(config: Dict[str, Any]) -> PBTConfig:
 
 
 def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """CLI entry; with ``elastic_resume`` set the run routes through the
+    elastic auto-resume controller (parallel/elastic.py).  PBT runs no
+    mid-run checkpoints (the population evolves in one sweep), so a
+    device loss here warm-restarts the sweep on the survivor mesh —
+    ``validate_population_axis`` re-runs honor-or-reject at entry, and
+    plan_survivor_shape already rejected shapes the population cannot
+    divide."""
+    from gymfx_tpu.parallel.elastic import elastic_entry
+
+    return elastic_entry(
+        _train_pbt_from_config, config,
+        must_divide=(int(config.get("pbt_population", 8) or 8),),
+    )
+
+
+def _train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.parallel import mesh_from_config, validate_population_axis
 
     mesh = mesh_from_config(config)
@@ -385,6 +401,7 @@ def train_pbt_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
             metadata={"policy": pcfg.policy,
                       "policy_kwargs": dict(pcfg.policy_kwargs),
                       "state_format": "params"},
+            keep=int(config.get("checkpoint_keep", 0) or 0),
         )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

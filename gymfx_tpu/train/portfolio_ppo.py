@@ -426,11 +426,24 @@ class PortfolioPPOTrainer:
         return self._train_step(state)
 
     def train(self, total_env_steps: int, seed: int = 0,
-              initial_params=None, initial_state=None):
+              initial_params=None, initial_state=None,
+              *, checkpoint_dir: Optional[str] = None,
+              checkpoint_every: int = 0, step_offset: int = 0,
+              checkpoint_metadata: Optional[Dict[str, Any]] = None,
+              preempt_at: Optional[int] = None,
+              telemetry=None,
+              mesh_faults=(),
+              checkpoint_keep: int = 0):
         """``initial_state`` continues a checkpointed run exactly (full
         PortfolioTrainState: params + opt state + env batch + RNG);
         ``initial_params`` is a params-only warm start — the same
-        contract as the single-pair trainers (train/ppo.py)."""
+        contract as the single-pair trainers (train/ppo.py).
+
+        The resilience hooks carry the same contract as PPOTrainer.train
+        (resilience/loop.py): periodic full-state checkpoints with
+        retention (``checkpoint_keep``), scripted ``mesh_faults`` and
+        mesh health supervision, simulated preemption, and ledger rows —
+        with every kwarg unset this loop is the exact pre-elastic one."""
         if initial_state is not None:
             state = initial_state
             if self.runtime is not None:
@@ -445,19 +458,53 @@ class PortfolioPPOTrainer:
                 state = self.runtime.place_state(state, self.STATE_PLAN)
         per_iter = self.pcfg.n_envs * self.pcfg.horizon
         iters = max(1, int(total_env_steps) // per_iter)
+        from gymfx_tpu.resilience.loop import ResilientLoop
+
+        supervisor = None
+        if self.runtime is not None and (mesh_faults or telemetry is not None):
+            from gymfx_tpu.parallel.elastic import MeshSupervisor
+
+            supervisor = MeshSupervisor(self.runtime.mesh)
+        hooks = ResilientLoop(
+            steps_per_iter=per_iter,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            step_offset=step_offset,
+            checkpoint_metadata=checkpoint_metadata,
+            max_consecutive_skips=0,
+            preempt_at=preempt_at,
+            ledger=telemetry.ledger if telemetry is not None else None,
+            recorder=telemetry.recorder if telemetry is not None else None,
+            mesh_faults=tuple(mesh_faults or ()),
+            supervisor=supervisor,
+            checkpoint_keep=int(checkpoint_keep or 0),
+        )
+        if telemetry is not None and supervisor is not None:
+            from gymfx_tpu.telemetry import register_mesh_health
+
+            register_mesh_health(
+                telemetry.registry, supervisor, name="portfolio_ppo"
+            )
         t0 = time.perf_counter()
         metrics: Dict[str, Any] = {}
         for it in range(iters):
+            hooks.begin_superstep(it, 1)
             if self.curriculum is not None:
                 _ti, _label, tape = self.curriculum.pick(it)
                 state, metrics = self._train_step_data(state, tape)
             else:
                 state, metrics = self.train_step(state)
+            hooks.after_superstep(
+                it, 1, metrics, lambda: (state._asdict(), state.params)
+            )
+        hooks.finish(lambda: (state._asdict(), state.params))
         jax.block_until_ready(state.params)
         out = {k: float(v) for k, v in metrics.items()}
         out["env_steps_per_sec"] = per_iter * iters / (time.perf_counter() - t0)
         out["iterations"] = iters
         out["total_env_steps"] = per_iter * iters
+        if hooks.last_checkpoint_step is not None:
+            out["last_checkpoint_step"] = hooks.last_checkpoint_step
         return state, out
 
 
@@ -561,6 +608,18 @@ def eval_portfolio_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """CLI entry; with ``elastic_resume`` set the run routes through the
+    elastic auto-resume controller (parallel/elastic.py, see
+    train/ppo.py train_from_config)."""
+    from gymfx_tpu.parallel.elastic import elastic_entry
+
+    return elastic_entry(
+        _train_portfolio_from_config, config,
+        must_divide=(int(config.get("num_envs", 64) or 64),),
+    )
+
+
+def _train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.train.common import (
         build_portfolio_train_eval_envs,
         labeled_eval_summary,
@@ -607,11 +666,44 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     resume_state, resume_params, resume_step = resume_from_config(
         config, trainer, PortfolioTrainState
     )
-    state, metrics = trainer.train(
-        int(config.get("train_total_steps", 1_000_000)),
-        seed=int(config.get("seed", 0) or 0),
-        initial_params=resume_params, initial_state=resume_state,
-    )
+    # the elastic/resilience wiring rides the inherited PPOTrainer.train
+    # loop: scripted mesh faults, periodic checkpoints, retention, and
+    # the mesh_resume ledger row on an elastic re-entry
+    from gymfx_tpu.resilience.faults import parse_fault_profile
+    from gymfx_tpu.telemetry import telemetry_from_config
+
+    profile = parse_fault_profile(config.get("fault_profile"))
+    telemetry = telemetry_from_config(config)
+    if telemetry is not None and telemetry.ledger is not None and (
+            resume_state is not None or resume_params is not None):
+        telemetry.ledger.record("checkpoint_restore", step=int(resume_step))
+        if config.get("elastic_attempt"):
+            telemetry.ledger.record(
+                "mesh_resume", step=int(resume_step),
+                attempt=int(config["elastic_attempt"]), verified=True,
+                mesh_shape=dict(mesh.shape) if mesh is not None else None,
+            )
+    try:
+        state, metrics = trainer.train(
+            int(config.get("train_total_steps", 1_000_000)),
+            seed=int(config.get("seed", 0) or 0),
+            initial_params=resume_params, initial_state=resume_state,
+            checkpoint_dir=config.get("checkpoint_dir"),
+            checkpoint_every=int(config.get("checkpoint_every", 0) or 0),
+            step_offset=resume_step,
+            checkpoint_metadata={"policy": f"portfolio_{pcfg.policy}",
+                                 "pairs": env.pairs},
+            preempt_at=profile.get("preempt_at"),
+            telemetry=telemetry,
+            mesh_faults=profile.get("mesh") or (),
+            checkpoint_keep=int(config.get("checkpoint_keep", 0) or 0),
+        )
+    except BaseException:
+        if telemetry is not None:
+            telemetry.close()
+        raise
+    if telemetry is not None:
+        telemetry.close()
     # held-out evaluation (VERDICT r4 item #3): greedy episode on the
     # aligned bars the agent never trained on, in-sample riding along
     summary = labeled_eval_summary(
@@ -632,12 +724,16 @@ def train_portfolio_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
         # composite format: the FULL train state for exact resume plus a
         # standalone params item for cheap evaluation restores; the step
         # is cumulative so a resumed run advances past the loaded step
-        save_checkpoint(
-            ckpt_dir, state._asdict(),
-            step=resume_step + metrics["total_env_steps"],
-            metadata={"policy": f"portfolio_{pcfg.policy}",
-                      "pairs": env.pairs},
-            params=state.params,
-        )
+        final_step = resume_step + metrics["total_env_steps"]
+        if metrics.get("last_checkpoint_step") != final_step:
+            save_checkpoint(
+                ckpt_dir, state._asdict(),
+                step=final_step,
+                metadata={"policy": f"portfolio_{pcfg.policy}",
+                          "pairs": env.pairs},
+                params=state.params,
+                keep=int(config.get("checkpoint_keep", 0) or 0),
+                protect=(int(resume_step),),
+            )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

@@ -642,7 +642,9 @@ class PPOTrainer:
               max_consecutive_skips: int = 10,
               preempt_at: Optional[int] = None,
               supersteps_per_dispatch: int = 1,
-              telemetry=None):
+              telemetry=None,
+              mesh_faults=(),
+              checkpoint_keep: int = 0):
         """Run PPO for ~total_env_steps; log metrics every ``log_every``
         iterations when > 0.  ``initial_state`` continues a checkpointed
         run exactly (full TrainState: params + opt_state + env batch +
@@ -693,6 +695,14 @@ class PPOTrainer:
             )
         else:
             logger = DelayedLogger("ppo", log_every, iters)
+        # mesh health supervision (parallel/elastic.py): only when the
+        # run has a mesh AND something observes it — scripted mesh
+        # faults or telemetry — so the no-mesh/no-knobs path is untouched
+        supervisor = None
+        if self.runtime is not None and (mesh_faults or telemetry is not None):
+            from gymfx_tpu.parallel.elastic import MeshSupervisor
+
+            supervisor = MeshSupervisor(self.runtime.mesh)
         hooks = ResilientLoop(
             steps_per_iter=steps_per_iter,
             checkpoint_dir=checkpoint_dir,
@@ -707,7 +717,14 @@ class PPOTrainer:
             ledger=telemetry.ledger if telemetry is not None else None,
             recorder=telemetry.recorder if telemetry is not None else None,
             profiler=telemetry.profiler if telemetry is not None else None,
+            mesh_faults=tuple(mesh_faults or ()),
+            supervisor=supervisor,
+            checkpoint_keep=int(checkpoint_keep or 0),
         )
+        if telemetry is not None and supervisor is not None:
+            from gymfx_tpu.telemetry import register_mesh_health
+
+            register_mesh_health(telemetry.registry, supervisor, name="ppo")
         if telemetry is not None and telemetry.profiler is not None:
             from gymfx_tpu.train.common import profiler_workload
 
@@ -874,7 +891,21 @@ def eval_policy_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
 
 def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     """CLI mode=training entry: train PPO, optionally checkpoint,
-    return a summary merging training metrics and greedy-eval metrics."""
+    return a summary merging training metrics and greedy-eval metrics.
+
+    With ``elastic_resume`` set, the run routes through the elastic
+    auto-resume controller (parallel/elastic.py): device loss re-plans
+    the mesh over survivors and resumes from the last digest-verified
+    checkpoint; unset, this call IS :func:`_train_from_config`."""
+    from gymfx_tpu.parallel.elastic import elastic_entry
+
+    return elastic_entry(
+        _train_from_config, config,
+        must_divide=(int(config.get("num_envs", 256) or 256),),
+    )
+
+
+def _train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     from gymfx_tpu.parallel import mesh_from_config, validate_batch_axis
     from gymfx_tpu.train.common import build_train_eval_envs
 
@@ -916,6 +947,14 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
     if telemetry is not None and telemetry.ledger is not None and (
             resume_state is not None or resume_params is not None):
         telemetry.ledger.record("checkpoint_restore", step=int(resume_step))
+        if config.get("elastic_attempt"):
+            # elastic re-entry: the restore above came back through the
+            # digest-verified path and re-enters the SURVIVOR mesh plan
+            telemetry.ledger.record(
+                "mesh_resume", step=int(resume_step),
+                attempt=int(config["elastic_attempt"]), verified=True,
+                mesh_shape=dict(mesh.shape) if mesh is not None else None,
+            )
     try:
         state, train_metrics = trainer.train(
             total, seed=int(config.get("seed", 0) or 0),
@@ -932,6 +971,8 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
                 config.get("supersteps_per_dispatch", 1) or 1
             ),
             telemetry=telemetry,
+            mesh_faults=profile.get("mesh") or (),
+            checkpoint_keep=int(config.get("checkpoint_keep", 0) or 0),
         )
     except BaseException:
         # abort paths (preemption drill, divergence) still seal the run
@@ -979,6 +1020,8 @@ def train_from_config(config: Dict[str, Any]) -> Dict[str, Any]:
                 metadata={"policy": pcfg.policy,
                           "policy_kwargs": dict(pcfg.policy_kwargs)},
                 params=state.params,
+                keep=int(config.get("checkpoint_keep", 0) or 0),
+                protect=(int(resume_step),),
             )
         summary["checkpoint_dir"] = str(ckpt_dir)
     return summary

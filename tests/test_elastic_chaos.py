@@ -1,0 +1,208 @@
+"""Elastic-chaos harness contract (tools/elastic_chaos.py +
+tools/elastic_report_schema.json).
+
+Two layers, mirroring tests/test_fleet_chaos.py: the schema validator
+must catch every class of report drift (missing keys, retyped fields,
+non-finite numbers, non-object maps), and the harness's pass bar must
+be falsifiable — a run with no device loss produces a FAILED report
+(no degrade, no resume), because a harness that cannot fail is not a
+harness.  The full passing drill (kill -> re-plan -> verified resume ->
+bitwise replay) runs as the tools/run_tests.sh elastic-chaos leg and,
+in-process, as the slow test at the bottom.
+"""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_chaos():
+    spec = importlib.util.spec_from_file_location(
+        "gymfx_elastic_chaos", REPO / "tools" / "elastic_chaos.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("gymfx_elastic_chaos", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+chaos = _load_chaos()
+
+
+def _good_report():
+    schema = chaos.load_schema()
+    report = {}
+    for key in schema["required"]:
+        if key in schema["integer"]:
+            report[key] = 0
+        elif key in schema["numeric"]:
+            report[key] = 0.0
+        elif key in schema["boolean"]:
+            report[key] = True
+        elif key in schema["object"]:
+            report[key] = {}
+        else:
+            report[key] = "x"
+    report["kind"] = "elastic_report"
+    report["schema_version"] = 1
+    return report
+
+
+# ----------------------------------------------------------------------
+# schema drift
+
+
+def test_validator_accepts_conforming_report():
+    assert chaos.validate_elastic_report(_good_report()) == []
+
+
+def test_validator_flags_every_drift_class():
+    base = _good_report()
+
+    wrong_kind = dict(base, kind="fleet_report")
+    assert any(
+        "kind" in p for p in chaos.validate_elastic_report(wrong_kind)
+    )
+
+    for key in ("attempts", "degrades", "resumes",
+                "lost_supersteps_past_checkpoint", "stream_preserving",
+                "postmortem_dumped", "replay_parity", "mesh_after",
+                "passed", "wall_s", "fault_profile"):
+        missing = dict(base)
+        del missing[key]
+        assert any(
+            key in p for p in chaos.validate_elastic_report(missing)
+        ), f"missing {key!r} not flagged"
+
+    retyped = dict(base, degrades=1.0)        # float where int pinned
+    assert any(
+        "degrades" in p for p in chaos.validate_elastic_report(retyped)
+    )
+    retyped = dict(base, degrades=True)       # bool is not an int here
+    assert any(
+        "degrades" in p for p in chaos.validate_elastic_report(retyped)
+    )
+    retyped = dict(base, replay_parity=1)     # int is not a bool
+    assert any(
+        "replay_parity" in p
+        for p in chaos.validate_elastic_report(retyped)
+    )
+    nonfinite = dict(base, wall_s=float("inf"))
+    assert any(
+        "wall_s" in p for p in chaos.validate_elastic_report(nonfinite)
+    )
+    not_a_map = dict(base, mesh_after=[2])
+    assert any(
+        "mesh_after" in p for p in chaos.validate_elastic_report(not_a_map)
+    )
+
+    assert chaos.validate_elastic_report(["not", "a", "dict"])
+
+
+def test_schema_file_pins_the_acceptance_keys():
+    schema = chaos.load_schema()
+    required = set(schema["required"])
+    # the CI leg's acceptance criteria must stay pinned
+    assert {"attempts", "degrades", "resumes",
+            "lost_supersteps_past_checkpoint", "stream_preserving",
+            "postmortem_dumped", "ledger_valid", "replay_parity",
+            "passed", "fault_profile"} <= required
+    # every typed key is also required (no optional typed fields)
+    for group in ("integer", "numeric", "boolean", "object"):
+        assert set(schema[group]) <= required
+
+
+def test_default_fault_profile_parses_as_a_mesh_kill():
+    """The harness default must stay inside the shared grammar — a
+    typo'd default would run a clean baseline and call it chaos."""
+    from gymfx_tpu.resilience.faults import parse_fault_profile
+
+    profile = parse_fault_profile(chaos.DEFAULT_FAULT_PROFILE)
+    assert len(profile["mesh"]) >= 1
+    assert all(ev["action"] == "kill" for ev in profile["mesh"])
+    # the scripted kill names a device the quick mesh actually has
+    assert all(
+        ev["device"] < chaos.VIRTUAL_DEVICES for ev in profile["mesh"]
+    )
+
+
+def test_quick_config_is_self_consistent():
+    cfg = chaos.QUICK_CONFIG
+    # envs shard evenly over the quick mesh, and the scripted kill can
+    # repartition: num_envs must divide over SOME smaller data axis
+    assert cfg["num_envs"] % cfg["mesh_shape"]["data"] == 0
+    assert cfg["elastic_resume"] is True
+    spi = cfg["num_envs"] * cfg["ppo_horizon"]
+    assert cfg["train_total_steps"] % spi == 0
+    assert (REPO / cfg["input_data_file"]).exists()
+
+
+# ----------------------------------------------------------------------
+# the bar must be falsifiable
+
+
+@pytest.fixture
+def _no_persistent_compile_cache():
+    # many meshes in one process segfault deserializing from the warm
+    # persistent compile cache (same workaround as the cross-mesh test
+    # in tests/test_sharded_runtime.py)
+    import jax
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+
+
+@pytest.mark.slow
+def test_chaos_without_faults_must_fail(tmp_path, _no_persistent_compile_cache):
+    """A harness that cannot fail is not a harness: an inert fault
+    profile (no ``mesh=`` event) yields zero degrades/resumes and the
+    report must come back failed — while still conforming to schema."""
+    cfg = dict(chaos.QUICK_CONFIG)
+    cfg["train_total_steps"] = cfg["num_envs"] * cfg["ppo_horizon"]  # 1 iter
+    report = chaos.run_elastic_chaos(
+        cfg,
+        fault_profile="seed=1",  # parses clean, injects nothing
+        workdir=str(tmp_path),
+        out=str(tmp_path / "elastic_report.json"),
+    )
+    assert chaos.validate_elastic_report(report) == []
+    assert report["passed"] is False
+    assert report["attempts"] == 0
+    assert report["degrades"] == 0 and report["resumes"] == 0
+    on_disk = json.loads((tmp_path / "elastic_report.json").read_text())
+    assert chaos.validate_elastic_report(on_disk) == []
+
+
+@pytest.mark.slow
+def test_quick_chaos_holds_the_acceptance_bar(
+    tmp_path, _no_persistent_compile_cache
+):
+    """The full drill in-process (the tools/run_tests.sh leg runs the
+    same thing as a subprocess on a 4-device mesh): kill device 3 at
+    superstep 2, re-plan to the survivors, verified resume with zero
+    supersteps lost, postmortem on disk, bitwise replay parity."""
+    report = chaos.run_elastic_chaos(
+        dict(chaos.QUICK_CONFIG),
+        fault_profile=chaos.DEFAULT_FAULT_PROFILE,
+        workdir=str(tmp_path),
+        out=str(tmp_path / "elastic_report.json"),
+    )
+    assert chaos.validate_elastic_report(report) == []
+    assert report["passed"] is True, report
+    assert report["attempts"] >= 1
+    assert report["degrades"] >= 1 and report["resumes"] >= 1
+    assert report["lost_supersteps_past_checkpoint"] == 0
+    assert report["stream_preserving"] is True
+    assert report["mesh_before"] == {"data": 4}
+    assert report["mesh_after"] == {"data": 2}
+    assert report["dead_devices"] == 1
+    assert report["postmortem_dumped"] is True
+    assert report["ledger_valid"] is True
+    assert report["replay_parity"] is True

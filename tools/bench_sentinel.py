@@ -247,6 +247,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--profile-threshold", type=float, default=0.25,
                     help="per-kernel regression threshold for "
                          "--profile-compare (default %(default)s)")
+    ap.add_argument("--elastic-report", default=None, metavar="REPORT",
+                    help="also gate an elastic_report.json "
+                         "(tools/elastic_chaos.py): schema drift folds "
+                         "into the sentinel's drift check, a failed "
+                         "chaos run fails the gate")
     args = ap.parse_args(argv)
 
     rows = load_bench_rows(args.dir)
@@ -284,6 +289,39 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )
             if prof.get("error"):
                 report["regressions"].append(prof["error"])
+
+    if args.elastic_report:
+        # elastic-chaos gate riding the same sentinel verdict: report
+        # schema drift is drift, a failed resume drill is a regression
+        try:
+            sys.path.insert(0, str(Path(__file__).resolve().parent))
+            from elastic_chaos import validate_elastic_report
+
+            elastic = json.loads(
+                Path(args.elastic_report).read_text(encoding="utf-8")
+            )
+            problems = validate_elastic_report(elastic)
+        except Exception as exc:
+            elastic = {}
+            problems = [f"elastic report unreadable: {exc!r}"]
+        report["elastic_report"] = {
+            "path": str(args.elastic_report),
+            "passed": bool(elastic.get("passed")),
+            "schema_problems": problems,
+        }
+        if problems:
+            report["ok"] = False
+            report["schema_drift"].extend(
+                f"elastic report: {p}" for p in problems
+            )
+        elif not elastic.get("passed"):
+            report["ok"] = False
+            report["regressions"].append(
+                f"elastic chaos drill failed: attempts="
+                f"{elastic.get('attempts')} lost_supersteps="
+                f"{elastic.get('lost_supersteps_past_checkpoint')} "
+                f"replay_parity={elastic.get('replay_parity')}"
+            )
 
     _publish_verdict(report)
 

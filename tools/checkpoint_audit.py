@@ -8,6 +8,12 @@ multi-GB tree from any box that can read the files:
 
     python tools/checkpoint_audit.py /path/to/ckpts
     python tools/checkpoint_audit.py /path/to/ckpts --json
+    python tools/checkpoint_audit.py /path/to/ckpts --keep 3
+
+``--keep N`` additionally reports what newest-N retention
+(``checkpoint_keep``, train/checkpoint.py prune_checkpoints) WOULD
+reclaim — which steps are prunable and how many bytes — without
+deleting anything.
 
 Exit status: 0 when every step verifies (legacy steps without a
 sidecar are accepted, flagged ``legacy``), 1 when any step fails, 2 on
@@ -30,6 +36,9 @@ def main(argv=None) -> int:
     ap.add_argument("directory", help="checkpoint tree to audit")
     ap.add_argument("--json", action="store_true",
                     help="emit the audit rows as JSON instead of a table")
+    ap.add_argument("--keep", type=int, default=0,
+                    help="report steps newest-N retention would prune "
+                         "(and the bytes reclaimed); nothing is deleted")
     args = ap.parse_args(argv)
 
     from gymfx_tpu.train.checkpoint import audit_checkpoint_tree
@@ -38,20 +47,39 @@ def main(argv=None) -> int:
     if not rows:
         print(f"no checkpoint steps under {args.directory}", file=sys.stderr)
         return 2
+    keep = int(args.keep or 0)
+    prunable = set()
+    if keep > 0:
+        steps = sorted(row["step"] for row in rows)
+        prunable = set(steps[:-keep])
+    for row in rows:
+        row["prunable"] = row["step"] in prunable
     if args.json:
         print(json.dumps(rows, indent=2, sort_keys=True))
     else:
-        print(f"{'step':>10}  {'status':<8}  {'files':>5}  digest")
+        print(f"{'step':>10}  {'status':<8}  {'files':>5}  "
+              f"{'bytes':>12}  digest")
         for row in rows:
             status = (
                 "legacy" if row["legacy"]
                 else ("ok" if row["verified"] else "FAILED")
             )
+            if row["prunable"]:
+                status += "*"
             print(
                 f"{row['step']:>10}  {status:<8}  "
                 f"{row['files'] if row['files'] is not None else '-':>5}  "
+                f"{row['bytes'] if row.get('bytes') is not None else '-':>12}  "
                 f"{row['digest'] or '-'}"
             )
+    if keep > 0:
+        reclaim = sum(
+            int(row.get("bytes") or 0) for row in rows if row["prunable"]
+        )
+        print(
+            f"retention --keep {keep}: {len(prunable)} prunable step(s) "
+            f"(marked *), {reclaim} bytes reclaimable", file=sys.stderr,
+        )
     failed = [row["step"] for row in rows if not row["verified"]]
     if failed:
         print(

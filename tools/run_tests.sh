@@ -396,6 +396,52 @@ with tempfile.TemporaryDirectory() as d:
 EOF
 echo "fleet-chaos quick (3 replicas, scripted kill): rc=$fleet_rc"
 
+# elastic-chaos quick leg: training on a 4-device virtual mesh loses
+# device 3 to a scripted mesh= kill, must re-plan to the survivor
+# shape, resume from the last digest-verified checkpoint with zero
+# supersteps lost past it, ledger the degrade/resume pair in
+# schema-valid per-attempt ledgers, and replay bitwise identical
+# (docs/resilience.md, "Elastic training")
+elastic_rc=0
+env JAX_PLATFORMS=cpu python - <<'EOF' || elastic_rc=$?
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, "tools")
+from elastic_chaos import validate_elastic_report  # noqa: E402
+
+with tempfile.TemporaryDirectory() as d:
+    out = Path(d) / "elastic_report.json"
+    run = subprocess.run(
+        [sys.executable, "tools/elastic_chaos.py", "--quick",
+         "--workdir", d, "--out", str(out)],
+        capture_output=True, text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if run.returncode != 0 or not out.exists():
+        print("elastic chaos CLI failed:",
+              run.stdout[-2000:], run.stderr[-2000:])
+        sys.exit(run.returncode or 1)
+    report = json.loads(out.read_text(encoding="utf-8"))
+    problems = validate_elastic_report(report)
+    if problems:
+        print("ELASTIC REPORT SCHEMA VIOLATIONS:", *problems, sep="\n  ")
+        sys.exit(1)
+    assert report["passed"] is True, report
+    assert report["degrades"] >= 1, report
+    assert report["resumes"] >= 1, report
+    assert report["lost_supersteps_past_checkpoint"] == 0, report
+    assert report["ledger_valid"] is True, report
+    assert report["replay_parity"] is True, report
+    print(f"elastic-chaos quick OK (mesh {report['mesh_before']} -> "
+          f"{report['mesh_after']}, resume at step "
+          f"{report['resume_step']}, replay bitwise-identical)")
+EOF
+echo "elastic-chaos quick (4-device mesh, scripted device loss): rc=$elastic_rc"
+
 # serve-load quick leg: the open-loop sustained-load harness over the
 # device-resident slot path (docs/serving.md, "Device-resident
 # sessions") must emit a schema-valid serve_load row with zero dropped
@@ -508,6 +554,9 @@ if [ "$soak_rc" -ne 0 ]; then
 fi
 if [ "$fleet_rc" -ne 0 ]; then
     exit "$fleet_rc"
+fi
+if [ "$elastic_rc" -ne 0 ]; then
+    exit "$elastic_rc"
 fi
 if [ "$serveload_rc" -ne 0 ]; then
     exit "$serveload_rc"
